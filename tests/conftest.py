@@ -1,0 +1,15 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's DistributedQueryRunner trick (SURVEY §4): multi-node
+paths are exercised in one process.  Env vars must be set before jax imports.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")

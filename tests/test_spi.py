@@ -1,0 +1,129 @@
+"""L0 tests: types, batch encoding, memory accounting."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from trino_tpu.spi import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    VARCHAR,
+    AggregatedMemoryContext,
+    Column,
+    ColumnBatch,
+    DecimalType,
+    ExceededMemoryLimitError,
+    MemoryPool,
+    common_super_type,
+    parse_type,
+    unify_dictionaries,
+)
+
+
+def test_parse_type():
+    assert parse_type("bigint") is BIGINT
+    assert parse_type("varchar(25)") is VARCHAR
+    t = parse_type("decimal(15,2)")
+    assert isinstance(t, DecimalType) and t.precision == 15 and t.scale == 2
+
+
+def test_common_super_type():
+    assert common_super_type(INTEGER, BIGINT) is BIGINT
+    assert common_super_type(BIGINT, DOUBLE) is DOUBLE
+    d = common_super_type(DecimalType(12, 2), DecimalType(10, 4))
+    assert isinstance(d, DecimalType) and d.scale == 4
+    assert common_super_type(DecimalType(12, 2), DOUBLE) is DOUBLE
+    assert common_super_type(BOOLEAN, BIGINT) is None
+
+
+def test_string_column_roundtrip():
+    vals = ["banana", "apple", None, "cherry", "apple"]
+    c = Column.from_values(VARCHAR, vals)
+    assert c.dictionary is not None
+    # dictionary sorted => code order == lexical order
+    assert list(c.dictionary) == sorted(set(["banana", "apple", "cherry", ""]))
+    assert c.to_pylist() == vals
+
+
+def test_date_decimal_roundtrip():
+    d = Column.from_values(DATE, ["1995-03-15", None, datetime.date(1992, 1, 2)])
+    assert d.to_pylist() == [datetime.date(1995, 3, 15), None, datetime.date(1992, 1, 2)]
+    dec = Column.from_values(DecimalType(12, 2), [1.5, None, "3.25"])
+    assert np.asarray(dec.data)[0] == 150
+    assert dec.to_pylist() == [1.5, None, 3.25]
+
+
+def test_batch_ops():
+    b = ColumnBatch.from_pydict(
+        {
+            "k": (BIGINT, [1, 2, 3, 4]),
+            "s": (VARCHAR, ["a", "b", "a", None]),
+        }
+    )
+    f = b.filter(np.array([True, False, True, True]))
+    assert f.num_rows == 3
+    assert f.column("k").to_pylist() == [1, 3, 4]
+    t = b.take(np.array([3, 0]))
+    assert t.column("s").to_pylist() == [None, "a"]
+    c = ColumnBatch.concat([b, t])
+    assert c.num_rows == 6
+    assert c.column("s").to_pylist() == ["a", "b", "a", None, None, "a"]
+
+
+def test_unify_dictionaries():
+    a = Column.from_values(VARCHAR, ["x", "y"])
+    b = Column.from_values(VARCHAR, ["y", "z"])
+    ua, ub = unify_dictionaries([a, b])
+    assert list(ua.dictionary) == list(ub.dictionary)
+    assert ua.to_pylist() == ["x", "y"]
+    assert ub.to_pylist() == ["y", "z"]
+
+
+def test_memory_accounting():
+    pool = MemoryPool("host", 1000)
+    root = AggregatedMemoryContext(pool=pool)
+    task = root.new_child()
+    op1 = task.new_local("op1")
+    op2 = task.new_local("op2")
+    op1.set_bytes(300)
+    op2.set_bytes(500)
+    assert pool.reserved == 800
+    op1.set_bytes(100)
+    assert pool.reserved == 600
+    with pytest.raises(ExceededMemoryLimitError):
+        op2.set_bytes(1000)
+    # failed reservation must not corrupt accounting
+    assert pool.reserved == 600
+    op1.close()
+    op2.close()
+    task.close()
+    root.close()
+    assert pool.reserved == 0
+    # use-after-close must raise, not drive the pool negative
+    with pytest.raises(RuntimeError):
+        op1.set_bytes(50)
+    assert pool.reserved == 0
+
+
+def test_decimal_exact_and_timestamp():
+    import decimal
+
+    big = 9007199254740993  # 2**53 + 1: not float64-representable
+    c = Column.from_values(DecimalType(18, 0), [big])
+    assert c.to_pylist()[0] == decimal.Decimal(big)
+    c2 = Column.from_values(DecimalType(10, 2), ["1.005"])
+    assert c2.to_pylist()[0] == decimal.Decimal("1.01")  # half-up
+    from trino_tpu.spi import TIMESTAMP
+
+    ts = Column.from_values(TIMESTAMP, ["2020-01-02 03:04:05.000006", None])
+    assert int(np.asarray(ts.data)[0]) == 1577934245000006
+    assert ts.to_pylist()[1] is None
+
+
+def test_concat_empty_list_raises():
+    with pytest.raises(ValueError):
+        ColumnBatch.concat([])

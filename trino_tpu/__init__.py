@@ -1,0 +1,9 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up re-design of Trino's capabilities (reference surveyed in
+SURVEY.md) for TPUs: the operator data plane compiles to XLA via jax.jit /
+Pallas, repartition shuffles become ICI collectives under a device mesh, and
+strings live as dictionary codes so devices only ever see fixed-width arrays.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Device kernels (JAX/XLA + Pallas) — the engine's "native" layer.
+
+Plays the role of Trino's runtime bytecode generation (``io.trino.sql.gen``,
+reference: sql/gen/PageFunctionCompiler.java:104) and hand-specialized
+flat-memory kernels (operator/FlatHash.java:42, operator/join/PagesHash.java):
+row expressions lower to jaxprs, hot group-by/join/repartition kernels are
+XLA programs (Pallas where XLA's codegen isn't enough).
+
+Importing this package configures JAX for the engine (x64 lanes for
+bigint/decimal); the pure-numpy SPI layer stays jax-free.
+"""
+
+import jax
+
+# Decimal/bigint paths require 64-bit lanes; on TPU int64 is emulated with
+# int32 pairs by XLA, fine for the bandwidth-bound relational ops.
+jax.config.update("jax_enable_x64", True)

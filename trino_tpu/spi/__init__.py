@@ -1,0 +1,42 @@
+"""SPI layer: types, columnar batches, memory accounting, connector contract.
+
+The re-expression of ``core/trino-spi`` (Page/Block/Type + connector SPI) in
+array-first terms — see the module docstrings for the design mapping.
+"""
+
+from .types import (  # noqa: F401
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    REAL,
+    SMALLINT,
+    TIMESTAMP,
+    TINYINT,
+    UNKNOWN,
+    VARCHAR,
+    DecimalType,
+    Type,
+    common_super_type,
+    is_integral,
+    is_numeric,
+    is_string,
+    parse_type,
+)
+from .batch import Column, ColumnBatch, encode_strings, unify_dictionaries  # noqa: F401
+from .memory import (  # noqa: F401
+    AggregatedMemoryContext,
+    ExceededMemoryLimitError,
+    LocalMemoryContext,
+    MemoryPool,
+)
+from .connector import (  # noqa: F401
+    ColumnSchema,
+    Connector,
+    ConnectorPageSink,
+    ConnectorPageSource,
+    Split,
+    TableSchema,
+    TableStatistics,
+)

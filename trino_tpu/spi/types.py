@@ -1,0 +1,201 @@
+"""Type system for the TPU-native engine.
+
+Mirrors the role of Trino's ``core/trino-spi/src/main/java/io/trino/spi/type``
+(``Type``, ``BigintType``, ``VarcharType``, ``DecimalType`` ...) but is designed
+array-first: every type declares a fixed-width *storage dtype* so that a column
+of any type is representable as a single fixed-shape device array (plus an
+optional validity bitmask and, for character types, a host-side dictionary).
+
+Key divergences from the JVM design (deliberate, TPU-first):
+
+- VARCHAR/CHAR are always dictionary encoded: the device sees ``int32`` codes
+  into a host-side *sorted* dictionary, so ``<``/``>`` comparisons and
+  ORDER BY on the codes are order-correct (see spi/batch.py). This replaces
+  Trino's ``VariableWidthBlock`` (reference: spi/block/VariableWidthBlock.java).
+- DECIMAL(p<=18, s) is a scaled int64 ("short decimal", mirrors
+  io.trino.spi.type.DecimalType's long path); arithmetic uses explicit
+  rescaling helpers.  p>18 is rejected for now (reference Int128 path:
+  spi/type/Int128Math.java).
+- DATE is int32 days since 1970-01-01, TIMESTAMP is int64 microseconds
+  (mirrors io.trino.spi.type.DateType / TimestampType storage).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from functools import total_ordering
+
+import numpy as np
+
+__all__ = [
+    "Type",
+    "BOOLEAN",
+    "TINYINT",
+    "SMALLINT",
+    "INTEGER",
+    "BIGINT",
+    "REAL",
+    "DOUBLE",
+    "VARCHAR",
+    "DATE",
+    "TIMESTAMP",
+    "DecimalType",
+    "UNKNOWN",
+    "parse_type",
+    "common_super_type",
+    "is_numeric",
+    "is_integral",
+    "is_string",
+]
+
+
+@total_ordering
+@dataclass(frozen=True)
+class Type:
+    """A SQL type with a fixed-width array storage representation."""
+
+    name: str
+    storage_dtype: np.dtype
+    # rank used for implicit-coercion decisions (higher wins); -1 = no coercion
+    _coercion_rank: int = -1
+
+    def __str__(self) -> str:  # pragma: no cover - debug
+        return self.name
+
+    def __lt__(self, other: "Type") -> bool:
+        return self.name < other.name
+
+    @property
+    def is_dictionary_encoded(self) -> bool:
+        return self.name in ("varchar", "char")
+
+    def zero_value(self):
+        """Neutral fill value for masked-out slots."""
+        return np.zeros((), dtype=self.storage_dtype)[()]
+
+
+@dataclass(frozen=True)
+class DecimalType(Type):
+    precision: int = 18
+    scale: int = 0
+
+    def __init__(self, precision: int = 18, scale: int = 0):
+        if precision > 18:
+            raise NotImplementedError(
+                f"decimal({precision},{scale}): precision > 18 (Int128 path) "
+                "not yet supported"
+            )
+        object.__setattr__(self, "name", f"decimal({precision},{scale})")
+        object.__setattr__(self, "storage_dtype", np.dtype(np.int64))
+        object.__setattr__(self, "_coercion_rank", 40)
+        object.__setattr__(self, "precision", precision)
+        object.__setattr__(self, "scale", scale)
+
+    def scale_factor(self) -> int:
+        return 10**self.scale
+
+
+BOOLEAN = Type("boolean", np.dtype(np.bool_), 0)
+TINYINT = Type("tinyint", np.dtype(np.int8), 10)
+SMALLINT = Type("smallint", np.dtype(np.int16), 11)
+INTEGER = Type("integer", np.dtype(np.int32), 12)
+BIGINT = Type("bigint", np.dtype(np.int64), 13)
+REAL = Type("real", np.dtype(np.float32), 50)
+DOUBLE = Type("double", np.dtype(np.float64), 51)
+VARCHAR = Type("varchar", np.dtype(np.int32))  # dictionary codes
+DATE = Type("date", np.dtype(np.int32))
+TIMESTAMP = Type("timestamp", np.dtype(np.int64))  # microseconds
+UNKNOWN = Type("unknown", np.dtype(np.bool_))  # type of NULL literal
+
+_INTEGRAL = {TINYINT.name, SMALLINT.name, INTEGER.name, BIGINT.name}
+_NUMERIC_RANKED = [TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE]
+
+
+def is_integral(t: Type) -> bool:
+    return t.name in _INTEGRAL
+
+
+def is_numeric(t: Type) -> bool:
+    return t.name in _INTEGRAL or t.name in (REAL.name, DOUBLE.name) or isinstance(t, DecimalType)
+
+
+def is_string(t: Type) -> bool:
+    return t.name in ("varchar", "char")
+
+
+def common_super_type(a: Type, b: Type) -> Type | None:
+    """Least common type for implicit coercion (mirrors
+    io.trino.type.TypeCoercion.getCommonSuperType)."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    if is_numeric(a) and is_numeric(b):
+        da, db = isinstance(a, DecimalType), isinstance(b, DecimalType)
+        if da and db:
+            scale = max(a.scale, b.scale)
+            ip = max(a.precision - a.scale, b.precision - b.scale)
+            return DecimalType(min(18, ip + scale), scale)
+        if da or db:
+            dec, other = (a, b) if da else (b, a)
+            if other.name in (DOUBLE.name, REAL.name):
+                return DOUBLE
+            # integral + decimal -> decimal wide enough for the integral
+            return DecimalType(18, dec.scale)
+        ra = a._coercion_rank
+        rb = b._coercion_rank
+        return a if ra >= rb else b
+    if is_string(a) and is_string(b):
+        return VARCHAR
+    if {a.name, b.name} == {DATE.name, TIMESTAMP.name}:
+        return TIMESTAMP
+    return None
+
+
+def parse_type(text: str) -> Type:
+    t = text.strip().lower()
+    simple = {
+        "boolean": BOOLEAN,
+        "tinyint": TINYINT,
+        "smallint": SMALLINT,
+        "int": INTEGER,
+        "integer": INTEGER,
+        "bigint": BIGINT,
+        "real": REAL,
+        "float": REAL,
+        "double": DOUBLE,
+        "date": DATE,
+        "timestamp": TIMESTAMP,
+        "varchar": VARCHAR,
+        "char": VARCHAR,
+        "string": VARCHAR,
+    }
+    if t in simple:
+        return simple[t]
+    if t.startswith("varchar(") or t.startswith("char("):
+        return VARCHAR
+    if t.startswith("decimal(") or t.startswith("numeric("):
+        inner = t[t.index("(") + 1 : t.rindex(")")]
+        parts = [p.strip() for p in inner.split(",")]
+        prec = int(parts[0])
+        scale = int(parts[1]) if len(parts) > 1 else 0
+        return DecimalType(prec, scale)
+    if t in ("decimal", "numeric"):
+        return DecimalType(18, 0)
+    raise ValueError(f"unknown type: {text!r}")
+
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def date_to_days(d: datetime.date | str) -> int:
+    if isinstance(d, str):
+        d = datetime.date.fromisoformat(d.strip())
+    return (d - _EPOCH).days
+
+
+def days_to_date(days: int) -> datetime.date:
+    return _EPOCH + datetime.timedelta(days=int(days))

@@ -1,7 +1,11 @@
 """Test harness config: run JAX on a virtual 8-device CPU mesh.
 
 Mirrors the reference's DistributedQueryRunner trick (SURVEY §4): multi-node
-paths are exercised in one process.  Env vars must be set before jax imports.
+paths are exercised in one process.
+
+Note: this environment preloads jax via sitecustomize (axon TPU tunnel), so
+plain JAX_PLATFORMS env vars are read too late — use jax.config instead.
+XLA_FLAGS still works because the CPU client is only created on first use.
 """
 
 import os
@@ -11,5 +15,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for tests"

@@ -1,0 +1,161 @@
+"""Expression IR -> JAX lowering tests (the sql/gen equivalent)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.spi import BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, Column, DecimalType
+from trino_tpu.sql.ir import Call, InputRef, Literal, call
+from trino_tpu.ops.expr import compile_expression
+
+import jax
+import jax.numpy as jnp
+
+
+def _cols(*columns):
+    out = []
+    for c in columns:
+        valid = None if c.valid is None else jnp.asarray(c.valid)
+        out.append((jnp.asarray(c.data), valid))
+    return out
+
+
+def test_arith_and_nulls():
+    a = Column.from_values(BIGINT, [1, 2, None, 4])
+    b = Column.from_values(BIGINT, [10, 0, 30, 40])
+    expr = call("add", BIGINT, InputRef(BIGINT, 0), InputRef(BIGINT, 1))
+    c = compile_expression(expr, [BIGINT, BIGINT])
+    data, valid = c(_cols(a, b))
+    assert list(np.asarray(data)[[0, 1, 3]]) == [11, 2, 44]
+    assert list(np.asarray(valid)) == [True, True, False, True]
+
+
+def test_division_by_zero_yields_null():
+    a = Column.from_values(BIGINT, [10, 7, -7])
+    b = Column.from_values(BIGINT, [0, 2, 2])
+    expr = call("divide", BIGINT, InputRef(BIGINT, 0), InputRef(BIGINT, 1))
+    data, valid = compile_expression(expr, [BIGINT, BIGINT])(_cols(a, b))
+    assert list(np.asarray(valid)) == [False, True, True]
+    # SQL integer division truncates toward zero
+    assert list(np.asarray(data)[[1, 2]]) == [3, -3]
+
+
+def test_decimal_arithmetic():
+    t = DecimalType(15, 2)
+    price = Column.from_values(t, ["100.00", "33.33"])
+    disc = Column.from_values(t, ["0.10", "0.05"])
+    # price * (1 - disc) -> decimal scale 4
+    one = Literal(DecimalType(15, 2), 1)
+    sub = call("subtract", DecimalType(15, 2), one, InputRef(t, 1))
+    mul = call("multiply", DecimalType(18, 4), InputRef(t, 0), sub)
+    data, valid = compile_expression(mul, [t, t])(_cols(price, disc))
+    assert valid is None
+    assert list(np.asarray(data)) == [900000, 316635]  # 90.0000, 31.6635
+
+
+def test_three_valued_logic():
+    x = Column.from_values(BOOLEAN, [True, False, None])
+    # x AND NULL: F->F, T->NULL, NULL->NULL
+    expr = call("$and", BOOLEAN, InputRef(BOOLEAN, 0), Literal(BOOLEAN, None))
+    data, valid = compile_expression(expr, [BOOLEAN])(_cols(x))
+    v = np.asarray(valid)
+    d = np.asarray(data)
+    assert not v[0] and not v[2]
+    assert v[1] and not d[1]
+    # x OR NULL: T->T, F->NULL
+    expr = call("$or", BOOLEAN, InputRef(BOOLEAN, 0), Literal(BOOLEAN, None))
+    data, valid = compile_expression(expr, [BOOLEAN])(_cols(x))
+    v, d = np.asarray(valid), np.asarray(data)
+    assert v[0] and d[0]
+    assert not v[1] and not v[2]
+
+
+def test_string_compare_like_in():
+    col = Column.from_values(VARCHAR, ["MAIL", "SHIP", "AIR", None, "RAIL"])
+    dicts = [col.dictionary]
+    ref = InputRef(VARCHAR, 0)
+    eq = call("eq", BOOLEAN, ref, Literal(VARCHAR, "SHIP"))
+    data, valid = compile_expression(eq, [VARCHAR], dicts)(_cols(col))
+    assert list(np.asarray(data) & np.asarray(valid)) == [False, True, False, False, False]
+    lt = call("lt", BOOLEAN, ref, Literal(VARCHAR, "MAIL"))
+    data, _ = compile_expression(lt, [VARCHAR], dicts)(_cols(col))
+    assert list(np.asarray(data)) == [False, False, True, True, False]  # AIR, "" < MAIL
+    inn = call("$in", BOOLEAN, ref, Literal(VARCHAR, "MAIL"), Literal(VARCHAR, "SHIP"))
+    data, _ = compile_expression(inn, [VARCHAR], dicts)(_cols(col))
+    assert list(np.asarray(data)) == [True, True, False, False, False]
+    like = call("$like", BOOLEAN, ref, Literal(VARCHAR, "%AI%"))
+    data, _ = compile_expression(like, [VARCHAR], dicts)(_cols(col))
+    assert list(np.asarray(data)) == [True, False, True, False, True]
+
+
+def test_string_transform_functions():
+    col = Column.from_values(VARCHAR, ["13-345", "29-999", "13-222"])
+    ref = InputRef(VARCHAR, 0)
+    sub = call("substring", VARCHAR, ref, Literal(BIGINT, 1), Literal(BIGINT, 2))
+    c = compile_expression(sub, [VARCHAR], [col.dictionary])
+    data, _ = c(_cols(col))
+    assert [str(c.dictionary[i]) for i in np.asarray(data)] == ["13", "29", "13"]
+    ln = call("length", BIGINT, ref)
+    data, _ = compile_expression(ln, [VARCHAR], [col.dictionary])(_cols(col))
+    assert list(np.asarray(data)) == [6, 6, 6]
+
+
+def test_dates():
+    col = Column.from_values(DATE, ["1995-03-15", "1996-12-31", "2000-02-29"])
+    ref = InputRef(DATE, 0)
+    yr = call("year", BIGINT, ref)
+    data, _ = compile_expression(yr, [DATE])(_cols(col))
+    assert list(np.asarray(data)) == [1995, 1996, 2000]
+    # date + 3 months with clamping: 1996-12-31 + 2 months = 1997-02-28
+    am = call("add_months", DATE, ref, Literal(BIGINT, 2))
+    data, _ = compile_expression(am, [DATE])(_cols(col))
+    import datetime
+
+    from trino_tpu.spi.types import days_to_date
+
+    assert days_to_date(int(np.asarray(data)[1])) == datetime.date(1997, 2, 28)
+    assert days_to_date(int(np.asarray(data)[2])) == datetime.date(2000, 4, 29)
+    cmp = call(
+        "ge", BOOLEAN, ref, Literal(DATE, "1996-01-01")
+    )
+    data, _ = compile_expression(cmp, [DATE])(_cols(col))
+    assert list(np.asarray(data)) == [False, True, True]
+
+
+def test_case_if_coalesce():
+    x = Column.from_values(BIGINT, [1, 2, None])
+    ref = InputRef(BIGINT, 0)
+    iff = call(
+        "$if", BIGINT, call("eq", BOOLEAN, ref, Literal(BIGINT, 1)),
+        Literal(BIGINT, 100), Literal(BIGINT, 200),
+    )
+    data, valid = compile_expression(iff, [BIGINT])(_cols(x))
+    assert list(np.asarray(data)) == [100, 200, 200]
+    coal = call("$coalesce", BIGINT, ref, Literal(BIGINT, -1))
+    data, valid = compile_expression(coal, [BIGINT])(_cols(x))
+    assert valid is None
+    assert list(np.asarray(data)) == [1, 2, -1]
+
+
+def test_cast_and_round():
+    t = DecimalType(10, 2)
+    x = Column.from_values(t, ["12.345".replace("5", ""), "99.99"])  # 12.34, 99.99
+    cast = call("$cast", DOUBLE, InputRef(t, 0))
+    data, _ = compile_expression(cast, [t])(_cols(x))
+    assert np.allclose(np.asarray(data), [12.34, 99.99])
+    rnd = call("round", t, InputRef(t, 0), Literal(BIGINT, 1))
+    data, _ = compile_expression(rnd, [t])(_cols(x))
+    assert list(np.asarray(data)) == [1230, 10000]
+
+
+def test_jit_fusion_compiles_once():
+    """A filter+project chain compiles into one jitted program."""
+    a = Column.from_values(BIGINT, list(range(8)))
+    expr = call(
+        "multiply", BIGINT,
+        call("add", BIGINT, InputRef(BIGINT, 0), Literal(BIGINT, 1)),
+        Literal(BIGINT, 2),
+    )
+    c = compile_expression(expr, [BIGINT])
+    jitted = jax.jit(lambda cols: c(cols))
+    data, _ = jitted(_cols(a))
+    assert list(np.asarray(data)) == [(i + 1) * 2 for i in range(8)]

@@ -1,0 +1,80 @@
+"""Date/time device kernels.
+
+Calendar math on int32 "days since 1970-01-01" arrays (the DATE storage) using
+Howard Hinnant's civil-calendar algorithms — branch-free integer ops that XLA
+vectorizes onto the VPU.  Mirrors the roles of io.trino.operator.scalar.
+DateTimeFunctions (reference: operator/scalar/DateTimeFunctions.java) without
+the JodaTime machinery: no timezones in v1 (DATE and naive TIMESTAMP only).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MICROS_PER_DAY = 86_400_000_000
+
+__all__ = [
+    "civil_from_days",
+    "days_from_civil",
+    "year_of",
+    "month_of",
+    "day_of",
+    "quarter_of",
+    "add_months",
+    "MICROS_PER_DAY",
+]
+
+
+def civil_from_days(z):
+    """days-since-epoch -> (year, month, day); exact for +/- millions of years."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146096), 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def days_from_civil(y, m, d):
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def year_of(days):
+    return civil_from_days(days)[0]
+
+
+def month_of(days):
+    return civil_from_days(days)[1]
+
+
+def day_of(days):
+    return civil_from_days(days)[2]
+
+
+def quarter_of(days):
+    return (civil_from_days(days)[1] + 2) // 3
+
+
+_DAYS_IN_MONTH = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+
+
+def add_months(days, n):
+    """DATE + INTERVAL n MONTH with end-of-month clamping (SQL semantics)."""
+    y, m, d = civil_from_days(days)
+    total = y * 12 + (m - 1) + n
+    ny = jnp.floor_divide(total, 12)
+    nm = jnp.remainder(total, 12) + 1
+    leap = ((ny % 4 == 0) & (ny % 100 != 0)) | (ny % 400 == 0)
+    dmax = _DAYS_IN_MONTH[nm - 1] + ((nm == 2) & leap)
+    return days_from_civil(ny, nm, jnp.minimum(d, dmax))

@@ -1,0 +1,6 @@
+"""SQL frontend: lexer/parser/AST, analyzer, row-expression IR.
+
+Re-expresses core/trino-parser + core/trino-main sql/analyzer + sql/relational
+(see module docstrings).  Pure Python, jax-free — lowering lives in
+``trino_tpu.ops``.
+"""

@@ -1,0 +1,73 @@
+"""Row-expression IR.
+
+The analogue of Trino's ``io.trino.sql.relational.RowExpression`` family
+(reference: core/trino-main sql/relational/RowExpression.java — CallExpression /
+ConstantExpression / InputReferenceExpression / SpecialForm).  Where Trino
+compiles this IR to JVM bytecode (sql/gen/PageFunctionCompiler.java:104), we
+lower it to a jaxpr via tracing (trino_tpu/ops/expr.py).
+
+Special forms are spelled as ``Call`` with ``$``-prefixed names so the IR stays
+two-node-kinds simple: ``$and $or $not $if $coalesce $in $is_null $cast
+$like $between``.  NULL semantics are SQL three-valued logic; every lowered
+expression produces a (value, validity) pair.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ..spi.types import Type
+
+__all__ = ["RowExpression", "InputRef", "Literal", "Call", "call"]
+
+
+@dataclass(frozen=True)
+class RowExpression:
+    type: Type
+
+
+@dataclass(frozen=True)
+class InputRef(RowExpression):
+    """Reference to input channel ``index`` of the operator's batch."""
+
+    index: int = 0
+
+    def __str__(self) -> str:
+        return f"#{self.index}"
+
+
+@dataclass(frozen=True)
+class Literal(RowExpression):
+    """A constant.  ``None`` value = typed SQL NULL.  Strings stay python
+    str here; the lowering resolves them against column dictionaries."""
+
+    value: Any = None
+
+    def __str__(self) -> str:
+        return repr(self.value)
+
+
+@dataclass(frozen=True)
+class Call(RowExpression):
+    name: str = ""
+    args: tuple[RowExpression, ...] = ()
+
+    def __str__(self) -> str:
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+def call(name: str, type_: Type, *args: RowExpression) -> Call:
+    return Call(type_, name, tuple(args))
+
+
+def walk(expr: RowExpression):
+    """Pre-order traversal."""
+    yield expr
+    if isinstance(expr, Call):
+        for a in expr.args:
+            yield from walk(a)
+
+
+def referenced_inputs(expr: RowExpression) -> set[int]:
+    return {e.index for e in walk(expr) if isinstance(e, InputRef)}

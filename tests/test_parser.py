@@ -1,0 +1,137 @@
+"""SQL parser tests: structure checks + the full TPC-H corpus."""
+
+import pytest
+
+from trino_tpu.connectors.tpch_queries import QUERIES
+from trino_tpu.sql import ast
+from trino_tpu.sql.parser import ParseError, parse_query, parse_statement
+
+
+def test_simple_select():
+    q = parse_query("select a, b + 1 as c from t where a > 10 order by c desc limit 5")
+    spec = q.body
+    assert len(spec.select) == 2
+    assert spec.select[1].alias == "c"
+    assert isinstance(spec.select[1].expr, ast.BinaryOp)
+    assert isinstance(spec.where, ast.Comparison)
+    assert q.limit == 5
+    assert not q.order_by[0].ascending
+
+
+def test_precedence():
+    q = parse_query("select * from t where a = 1 or b = 2 and c < 3 + 4 * 5")
+    w = q.body.where
+    assert isinstance(w, ast.LogicalOp) and w.op == "OR"
+    rhs = w.terms[1]
+    assert isinstance(rhs, ast.LogicalOp) and rhs.op == "AND"
+    cmp = rhs.terms[1]
+    assert isinstance(cmp, ast.Comparison)
+    add = cmp.right
+    assert isinstance(add, ast.BinaryOp) and add.op == "+"
+    assert isinstance(add.right, ast.BinaryOp) and add.right.op == "*"
+
+
+def test_joins_and_aliases():
+    q = parse_query(
+        "select n1.n_name from nation n1 join nation n2 on n1.n_regionkey = n2.n_regionkey"
+        " left join region on n1.n_regionkey = r_regionkey"
+    )
+    j = q.body.from_
+    assert isinstance(j, ast.Join) and j.join_type == "LEFT"
+    inner = j.left
+    assert isinstance(inner, ast.Join) and inner.join_type == "INNER"
+    assert inner.left == ast.Table("nation", "n1")
+
+
+def test_implicit_cross_join():
+    q = parse_query("select * from a, b, c where a.x = b.y")
+    j = q.body.from_
+    assert isinstance(j, ast.Join) and j.join_type == "CROSS"
+    assert isinstance(j.left, ast.Join) and j.left.join_type == "CROSS"
+
+
+def test_case_cast_extract_interval():
+    q = parse_query(
+        "select case when x = 1 then 'one' else 'other' end,"
+        " cast(x as double), extract(year from d),"
+        " d + interval '3' month from t"
+    )
+    c, cast, ext, add = [i.expr for i in q.body.select]
+    assert isinstance(c, ast.Case) and c.operand is None and c.default is not None
+    assert isinstance(cast, ast.Cast) and cast.type_name == "double"
+    assert isinstance(ext, ast.Extract) and ext.field_ == "YEAR"
+    assert isinstance(add, ast.BinaryOp) and isinstance(add.right, ast.IntervalLiteral)
+    assert add.right.unit == "MONTH"
+
+
+def test_not_binding():
+    q = parse_query("select * from t where not a like 'x%' and b not in (1, 2)")
+    w = q.body.where
+    assert isinstance(w, ast.LogicalOp) and w.op == "AND"
+    assert isinstance(w.terms[0], ast.Not)
+    assert isinstance(w.terms[0].operand, ast.Like)
+    assert isinstance(w.terms[1], ast.InList) and w.terms[1].negated
+
+
+def test_exists_subqueries():
+    q = parse_query(
+        "select * from t where exists (select 1 from u where u.a = t.a)"
+        " and x = (select max(y) from v)"
+    )
+    w = q.body.where
+    assert isinstance(w.terms[0], ast.Exists)
+    assert isinstance(w.terms[1].right, ast.ScalarSubquery)
+
+
+def test_with_clause():
+    q = parse_query("with r as (select a from t) select * from r where a > 0")
+    assert len(q.with_) == 1 and q.with_[0].name == "r"
+
+
+def test_quoted_identifiers_and_strings():
+    q = parse_query('select "my col" from "my table" where s = \'it\'\'s\'')
+    assert q.body.select[0].expr == ast.ColumnRef(("my col",))
+    assert q.body.where.right == ast.StringLiteral("it's")
+
+
+def test_errors_have_position():
+    with pytest.raises(ParseError, match="line 1"):
+        parse_query("select from t")
+    with pytest.raises(ParseError):
+        parse_query("select a from t where")
+    with pytest.raises(ParseError, match="trailing"):
+        parse_query("select a from t garbage garbage")
+
+
+def test_statements():
+    s = parse_statement("explain analyze select 1")
+    assert isinstance(s, ast.Explain) and s.analyze
+    s = parse_statement("create table x as select * from y")
+    assert isinstance(s, ast.CreateTableAsSelect) and s.table == "x"
+    s = parse_statement("insert into x select * from y")
+    assert isinstance(s, ast.InsertInto)
+    assert isinstance(parse_statement("show tables"), ast.ShowTables)
+
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_parses_all_tpch(qnum):
+    q = parse_query(QUERIES[qnum])
+    assert isinstance(q, ast.Query)
+    assert len(q.body.select) >= 1
+
+
+def test_tpch_q1_shape():
+    q = parse_query(QUERIES[1])
+    assert len(q.body.select) == 10
+    assert len(q.body.group_by) == 2
+    assert len(q.order_by) == 2
+    # where: l_shipdate <= date - interval
+    w = q.body.where
+    assert isinstance(w, ast.Comparison) and w.op == "<="
+    assert isinstance(w.right, ast.BinaryOp) and w.right.op == "-"
+
+
+def test_tpch_q19_or_of_ands():
+    q = parse_query(QUERIES[19])
+    w = q.body.where
+    assert isinstance(w, ast.LogicalOp) and w.op == "OR" and len(w.terms) == 3

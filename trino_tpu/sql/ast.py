@@ -1,0 +1,302 @@
+"""SQL AST node definitions.
+
+The analogue of ``core/trino-parser``'s tree package (reference:
+core/trino-parser/src/main/java/io/trino/sql/tree — Query,
+QuerySpecification, Select, Join, ComparisonExpression, ...), trimmed to the
+grammar subset the engine supports and grown alongside it.  Pure dataclasses;
+no behavior beyond printing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+# --------------------------------------------------------------------------
+# expressions
+
+
+@dataclass(frozen=True)
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    parts: tuple[str, ...]  # e.g. ("lineitem", "l_orderkey") or ("l_orderkey",)
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@dataclass(frozen=True)
+class IntLiteral(Expr):
+    value: int
+
+
+@dataclass(frozen=True)
+class DecimalLiteral(Expr):
+    text: str  # keep exact text; analyzer decides decimal(p,s)
+
+
+@dataclass(frozen=True)
+class DoubleLiteral(Expr):
+    value: float
+
+
+@dataclass(frozen=True)
+class StringLiteral(Expr):
+    value: str
+
+
+@dataclass(frozen=True)
+class BooleanLiteral(Expr):
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLiteral(Expr):
+    pass
+
+
+@dataclass(frozen=True)
+class DateLiteral(Expr):
+    text: str  # 'YYYY-MM-DD'
+
+
+@dataclass(frozen=True)
+class TimestampLiteral(Expr):
+    text: str
+
+
+@dataclass(frozen=True)
+class IntervalLiteral(Expr):
+    value: str  # e.g. '3'
+    unit: str  # DAY | MONTH | YEAR
+    negative: bool = False
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / %
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # - +
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class Comparison(Expr):
+    op: str  # = <> < <= > >=
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class LogicalOp(Expr):
+    op: str  # AND | OR
+    terms: tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    operand: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    operand: Expr
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Expr):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    operand: Expr
+    pattern: Expr
+    escape: Optional[Expr] = None
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class FunctionCall(Expr):
+    name: str
+    args: tuple[Expr, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    operand: Expr
+    type_name: str
+
+
+@dataclass(frozen=True)
+class Extract(Expr):
+    field_: str  # YEAR | MONTH | DAY | QUARTER
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class WhenClause:
+    condition: Expr  # for simple case: the comparand value
+    result: Expr
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    operand: Optional[Expr]  # simple CASE has an operand; searched has None
+    whens: tuple[WhenClause, ...]
+    default: Optional[Expr]
+
+
+# --------------------------------------------------------------------------
+# relations
+
+
+@dataclass(frozen=True)
+class Relation:
+    pass
+
+
+@dataclass(frozen=True)
+class Table(Relation):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRelation(Relation):
+    query: "Query"
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Join(Relation):
+    join_type: str  # INNER | LEFT | RIGHT | FULL | CROSS
+    left: Relation
+    right: Relation
+    condition: Optional[Expr] = None  # ON expr; None for CROSS / implicit
+
+
+# --------------------------------------------------------------------------
+# query structure
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Optional[Expr]  # None => * (all columns)
+    alias: Optional[str] = None
+    star_prefix: Optional[str] = None  # t.* support
+
+
+@dataclass(frozen=True)
+class SortItem:
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class QuerySpec:
+    select: tuple[SelectItem, ...]
+    distinct: bool = False
+    from_: Optional[Relation] = None
+    where: Optional[Expr] = None
+    group_by: tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class WithQuery:
+    name: str
+    query: "Query"
+    column_names: Optional[tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
+class Query:
+    body: QuerySpec
+    order_by: tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    with_: tuple[WithQuery, ...] = ()
+
+
+# --------------------------------------------------------------------------
+# statements
+
+
+@dataclass(frozen=True)
+class Statement:
+    pass
+
+
+@dataclass(frozen=True)
+class QueryStatement(Statement):
+    query: Query
+
+
+@dataclass(frozen=True)
+class Explain(Statement):
+    statement: Statement
+    analyze: bool = False
+    type_: str = "LOGICAL"  # LOGICAL | DISTRIBUTED
+
+
+@dataclass(frozen=True)
+class CreateTableAsSelect(Statement):
+    table: str
+    query: Query
+
+
+@dataclass(frozen=True)
+class InsertInto(Statement):
+    table: str
+    query: Query
+
+
+@dataclass(frozen=True)
+class ShowTables(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class ShowColumns(Statement):
+    table: str = ""

@@ -1,0 +1,636 @@
+"""SQL lexer + recursive-descent/Pratt parser.
+
+Hand-written equivalent of the ANTLR pipeline in ``core/trino-grammar``
+(SqlBase.g4, 1,420 lines) + ``core/trino-parser``'s AstBuilder.  Covers the
+engine's supported subset (full TPC-H shape: joins, subqueries, CTEs,
+aggregates, CASE, CAST, EXTRACT, BETWEEN/IN/LIKE/EXISTS, date/interval
+literals) and is grown feature-by-feature with the engine.
+
+Operator precedence follows SqlBase.g4's booleanExpression/valueExpression
+nesting: OR < AND < NOT < predicate (comparison, BETWEEN, IN, LIKE, IS) <
+additive < multiplicative < unary.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from . import ast
+
+__all__ = ["parse_statement", "parse_query", "ParseError"]
+
+
+class ParseError(ValueError):
+    def __init__(self, message: str, position: int = -1, text: str = ""):
+        ctx = ""
+        if position >= 0 and text:
+            line = text.count("\n", 0, position) + 1
+            col = position - (text.rfind("\n", 0, position) + 1) + 1
+            snippet = text[max(0, position - 20) : position + 20].replace("\n", " ")
+            ctx = f" at line {line}:{col} near '...{snippet}...'"
+        super().__init__(message + ctx)
+
+
+# --------------------------------------------------------------------------
+# lexer
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+|\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|>=|<=|\|\||->|[=<>+\-*/%(),.;])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "exists", "between", "like", "escape",
+    "is", "null", "true", "false", "case", "when", "then", "else", "end",
+    "cast", "extract", "distinct", "all", "join", "inner", "left", "right",
+    "full", "outer", "cross", "on", "using", "with", "union", "except",
+    "intersect", "date", "timestamp", "interval", "year", "month", "day",
+    "quarter", "hour", "minute", "second", "asc", "desc", "nulls", "first",
+    "last", "explain", "analyze", "create", "table", "insert", "into",
+    "values", "show", "tables", "columns", "describe", "substring", "for",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # number|string|ident|qident|op|kw|eof
+    text: str
+    pos: int
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens: list[Token] = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise ParseError(f"unexpected character {sql[pos]!r}", pos, sql)
+        kind = m.lastgroup
+        text = m.group()
+        if kind != "ws":
+            if kind == "ident" and text.lower() in KEYWORDS:
+                tokens.append(Token("kw", text.lower(), pos))
+            elif kind == "qident":
+                tokens.append(Token("ident", text[1:-1].replace('""', '"'), pos))
+            elif kind == "string":
+                tokens.append(Token("string", text[1:-1].replace("''", "'"), pos))
+            else:
+                tokens.append(Token(kind, text, pos))
+        pos = m.end()
+    tokens.append(Token("eof", "", n))
+    return tokens
+
+
+# --------------------------------------------------------------------------
+# parser
+
+
+class _Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.tokens[self.i]
+
+    def peek_kw(self, *kws: str) -> bool:
+        t = self.cur
+        return t.kind == "kw" and t.text in kws
+
+    def peek_op(self, *ops: str) -> bool:
+        t = self.cur
+        return t.kind == "op" and t.text in ops
+
+    def advance(self) -> Token:
+        t = self.cur
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        if self.peek_kw(*kws):
+            return self.advance().text
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.peek_op(*ops):
+            return self.advance().text
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            self.fail(f"expected {kw.upper()}")
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            self.fail(f"expected '{op}'")
+
+    def expect_ident(self) -> str:
+        t = self.cur
+        if t.kind == "ident":
+            return self.advance().text
+        # allow non-reserved keywords as identifiers where unambiguous
+        if t.kind == "kw" and t.text in ("year", "month", "day", "quarter",
+                                         "date", "first", "last", "tables",
+                                         "columns", "values"):
+            return self.advance().text
+        self.fail("expected identifier")
+
+    def fail(self, msg: str):
+        raise ParseError(f"{msg}, found {self.cur.kind} {self.cur.text!r}",
+                         self.cur.pos, self.sql)
+
+    # -- statements -------------------------------------------------------
+    def parse_statement(self) -> ast.Statement:
+        if self.accept_kw("explain"):
+            analyze = bool(self.accept_kw("analyze"))
+            inner = self.parse_statement()
+            return ast.Explain(inner, analyze=analyze)
+        if self.peek_kw("select", "with"):
+            return ast.QueryStatement(self.parse_query())
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            name = self.qualified_name()
+            self.expect_kw("as")
+            return ast.CreateTableAsSelect(name, self.parse_query())
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            name = self.qualified_name()
+            return ast.InsertInto(name, self.parse_query())
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                return ast.ShowTables()
+            if self.accept_kw("columns"):
+                self.expect_kw("from")
+                return ast.ShowColumns(self.qualified_name())
+            self.fail("expected TABLES or COLUMNS")
+        if self.accept_kw("describe"):
+            return ast.ShowColumns(self.qualified_name())
+        self.fail("expected statement")
+
+    def qualified_name(self) -> str:
+        parts = [self.expect_ident()]
+        while self.accept_op("."):
+            parts.append(self.expect_ident())
+        return ".".join(parts)
+
+    # -- query ------------------------------------------------------------
+    def parse_query(self) -> ast.Query:
+        withs: list[ast.WithQuery] = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.expect_ident()
+                colnames = None
+                if self.accept_op("("):
+                    cols = [self.expect_ident()]
+                    while self.accept_op(","):
+                        cols.append(self.expect_ident())
+                    self.expect_op(")")
+                    colnames = tuple(cols)
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                withs.append(ast.WithQuery(name, q, colnames))
+                if not self.accept_op(","):
+                    break
+        body = self.parse_query_spec()
+        order_by: tuple[ast.SortItem, ...] = ()
+        limit = None
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by = tuple(self.parse_sort_items())
+        if self.accept_kw("limit"):
+            t = self.cur
+            if t.kind == "number":
+                limit = int(self.advance().text)
+            elif t.kind == "kw" and t.text == "all":
+                self.advance()
+            else:
+                self.fail("expected LIMIT count")
+        return ast.Query(body, order_by, limit, tuple(withs))
+
+    def parse_sort_items(self) -> list[ast.SortItem]:
+        items = []
+        while True:
+            e = self.parse_expr()
+            asc = True
+            if self.accept_kw("asc"):
+                asc = True
+            elif self.accept_kw("desc"):
+                asc = False
+            nulls_first = None
+            if self.accept_kw("nulls"):
+                if self.accept_kw("first"):
+                    nulls_first = True
+                elif self.accept_kw("last"):
+                    nulls_first = False
+                else:
+                    self.fail("expected FIRST or LAST")
+            items.append(ast.SortItem(e, asc, nulls_first))
+            if not self.accept_op(","):
+                return items
+
+    def parse_query_spec(self) -> ast.QuerySpec:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        select = [self.parse_select_item()]
+        while self.accept_op(","):
+            select.append(self.parse_select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.parse_relation()
+            while self.accept_op(","):
+                right = self.parse_relation()
+                from_ = ast.Join("CROSS", from_, right, None)
+        where = self.parse_expr() if self.accept_kw("where") else None
+        group_by: tuple[ast.Expr, ...] = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            gb = [self.parse_expr()]
+            while self.accept_op(","):
+                gb.append(self.parse_expr())
+            group_by = tuple(gb)
+        having = self.parse_expr() if self.accept_kw("having") else None
+        return ast.QuerySpec(tuple(select), distinct, from_, where, group_by, having)
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.accept_op("*"):
+            return ast.SelectItem(None)
+        # t.* handled after expr parse would be messy; look ahead
+        if (self.cur.kind == "ident" and self.tokens[self.i + 1].text == "."
+                and self.tokens[self.i + 2].text == "*"):
+            prefix = self.advance().text
+            self.advance()
+            self.advance()
+            return ast.SelectItem(None, star_prefix=prefix)
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "ident":
+            alias = self.advance().text
+        return ast.SelectItem(e, alias)
+
+    # -- relations --------------------------------------------------------
+    def parse_relation(self) -> ast.Relation:
+        left = self.parse_relation_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_relation_primary()
+                left = ast.Join("CROSS", left, right, None)
+                continue
+            jt = None
+            if self.peek_kw("join"):
+                jt = "INNER"
+            elif self.peek_kw("inner"):
+                self.advance()
+                jt = "INNER"
+            elif self.peek_kw("left"):
+                self.advance()
+                self.accept_kw("outer")
+                jt = "LEFT"
+            elif self.peek_kw("right"):
+                self.advance()
+                self.accept_kw("outer")
+                jt = "RIGHT"
+            elif self.peek_kw("full"):
+                self.advance()
+                self.accept_kw("outer")
+                jt = "FULL"
+            if jt is None:
+                return left
+            self.expect_kw("join")
+            right = self.parse_relation_primary()
+            self.expect_kw("on")
+            cond = self.parse_expr()
+            left = ast.Join(jt, left, right, cond)
+
+    def parse_relation_primary(self) -> ast.Relation:
+        if self.accept_op("("):
+            q = self.parse_query()
+            self.expect_op(")")
+            alias = self._maybe_alias()
+            return ast.SubqueryRelation(q, alias)
+        name = self.qualified_name()
+        alias = self._maybe_alias()
+        return ast.Table(name, alias)
+
+    def _maybe_alias(self) -> Optional[str]:
+        if self.accept_kw("as"):
+            return self.expect_ident()
+        if self.cur.kind == "ident":
+            return self.advance().text
+        return None
+
+    # -- expressions (Pratt) ----------------------------------------------
+    def parse_expr(self) -> ast.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Expr:
+        terms = [self.parse_and()]
+        while self.accept_kw("or"):
+            terms.append(self.parse_and())
+        if len(terms) == 1:
+            return terms[0]
+        return ast.LogicalOp("OR", tuple(terms))
+
+    def parse_and(self) -> ast.Expr:
+        terms = [self.parse_not()]
+        while self.accept_kw("and"):
+            terms.append(self.parse_not())
+        if len(terms) == 1:
+            return terms[0]
+        return ast.LogicalOp("AND", tuple(terms))
+
+    def parse_not(self) -> ast.Expr:
+        if self.accept_kw("not"):
+            return ast.Not(self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> ast.Expr:
+        if self.peek_kw("exists"):
+            self.advance()
+            self.expect_op("(")
+            q = self.parse_query()
+            self.expect_op(")")
+            return ast.Exists(q)
+        left = self.parse_additive()
+        while True:
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                low = self.parse_additive()
+                self.expect_kw("and")
+                high = self.parse_additive()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.peek_kw("select", "with"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(items), negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.parse_additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self.parse_additive()
+                left = ast.Like(left, pattern, escape, negated)
+                continue
+            if negated:
+                self.i = save  # NOT belongs to something else
+                break
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = ast.IsNull(left, neg)
+                continue
+            op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+            if op:
+                right = self.parse_additive()
+                left = ast.Comparison("<>" if op == "!=" else op, left, right)
+                continue
+            break
+        return left
+
+    def parse_additive(self) -> ast.Expr:
+        left = self.parse_multiplicative()
+        while True:
+            op = self.accept_op("+", "-", "||")
+            if not op:
+                return left
+            right = self.parse_multiplicative()
+            left = ast.BinaryOp(op, left, right)
+
+    def parse_multiplicative(self) -> ast.Expr:
+        left = self.parse_unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return left
+            right = self.parse_unary()
+            left = ast.BinaryOp(op, left, right)
+
+    def parse_unary(self) -> ast.Expr:
+        op = self.accept_op("-", "+")
+        if op:
+            operand = self.parse_unary()
+            if op == "-":
+                if isinstance(operand, ast.IntLiteral):
+                    return ast.IntLiteral(-operand.value)
+                if isinstance(operand, ast.DoubleLiteral):
+                    return ast.DoubleLiteral(-operand.value)
+                if isinstance(operand, ast.DecimalLiteral):
+                    return ast.DecimalLiteral("-" + operand.text)
+                return ast.UnaryOp("-", operand)
+            return operand
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> ast.Expr:
+        e = self.parse_primary()
+        while self.accept_op("."):
+            if not isinstance(e, ast.ColumnRef):
+                self.fail("unexpected '.'")
+            e = ast.ColumnRef(e.parts + (self.expect_ident(),))
+        return e
+
+    def parse_primary(self) -> ast.Expr:
+        t = self.cur
+        if t.kind == "number":
+            self.advance()
+            if re.fullmatch(r"\d+", t.text):
+                return ast.IntLiteral(int(t.text))
+            if "e" in t.text.lower():
+                return ast.DoubleLiteral(float(t.text))
+            return ast.DecimalLiteral(t.text)
+        if t.kind == "string":
+            self.advance()
+            return ast.StringLiteral(t.text)
+        if t.kind == "kw":
+            if t.text == "null":
+                self.advance()
+                return ast.NullLiteral()
+            if t.text in ("true", "false"):
+                self.advance()
+                return ast.BooleanLiteral(t.text == "true")
+            if t.text == "date":
+                nxt = self.tokens[self.i + 1]
+                if nxt.kind == "string":
+                    self.advance()
+                    return ast.DateLiteral(self.advance().text)
+            if t.text == "timestamp":
+                nxt = self.tokens[self.i + 1]
+                if nxt.kind == "string":
+                    self.advance()
+                    return ast.TimestampLiteral(self.advance().text)
+            if t.text == "interval":
+                self.advance()
+                neg = False
+                if self.accept_op("-"):
+                    neg = True
+                v = self.cur
+                if v.kind != "string" and v.kind != "number":
+                    self.fail("expected interval value")
+                self.advance()
+                unit = self.cur
+                if unit.kind != "kw" or unit.text not in (
+                    "year", "month", "day", "hour", "minute", "second"
+                ):
+                    self.fail("expected interval unit")
+                self.advance()
+                return ast.IntervalLiteral(v.text, unit.text.upper(), neg)
+            if t.text == "case":
+                return self.parse_case()
+            if t.text == "cast":
+                self.advance()
+                self.expect_op("(")
+                inner = self.parse_expr()
+                self.expect_kw("as")
+                type_name = self.parse_type_name()
+                self.expect_op(")")
+                return ast.Cast(inner, type_name)
+            if t.text == "extract":
+                self.advance()
+                self.expect_op("(")
+                fld = self.cur
+                if fld.kind != "kw" or fld.text not in (
+                    "year", "month", "day", "quarter", "hour", "minute", "second"
+                ):
+                    self.fail("expected extract field")
+                self.advance()
+                self.expect_kw("from")
+                inner = self.parse_expr()
+                self.expect_op(")")
+                return ast.Extract(fld.text.upper(), inner)
+            if t.text == "substring":
+                self.advance()
+                self.expect_op("(")
+                inner = self.parse_expr()
+                if self.accept_kw("from"):
+                    start = self.parse_expr()
+                    length = self.parse_expr() if self.accept_kw("for") else None
+                else:
+                    self.expect_op(",")
+                    start = self.parse_expr()
+                    length = None
+                    if self.accept_op(","):
+                        length = self.parse_expr()
+                self.expect_op(")")
+                args = (inner, start) + ((length,) if length is not None else ())
+                return ast.FunctionCall("substring", args)
+            if t.text in ("year", "month", "day", "quarter"):
+                # allow year(x) style
+                nxt = self.tokens[self.i + 1]
+                if nxt.kind == "op" and nxt.text == "(":
+                    self.advance()
+                    self.expect_op("(")
+                    inner = self.parse_expr()
+                    self.expect_op(")")
+                    return ast.FunctionCall(t.text, (inner,))
+        if t.kind == "op" and t.text == "(":
+            self.advance()
+            if self.peek_kw("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident":
+            nxt = self.tokens[self.i + 1]
+            if nxt.kind == "op" and nxt.text == "(":
+                name = self.advance().text.lower()
+                self.expect_op("(")
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return ast.FunctionCall(name, (), is_star=True)
+                distinct = bool(self.accept_kw("distinct"))
+                args: list[ast.Expr] = []
+                if not self.peek_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.FunctionCall(name, tuple(args), distinct)
+            return ast.ColumnRef((self.advance().text,))
+        self.fail("expected expression")
+
+    def parse_case(self) -> ast.Expr:
+        self.expect_kw("case")
+        operand = None
+        if not self.peek_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            result = self.parse_expr()
+            whens.append(ast.WhenClause(cond, result))
+        default = None
+        if self.accept_kw("else"):
+            default = self.parse_expr()
+        self.expect_kw("end")
+        if not whens:
+            self.fail("CASE requires at least one WHEN")
+        return ast.Case(operand, tuple(whens), default)
+
+    def parse_type_name(self) -> str:
+        parts = [self.expect_type_word()]
+        if parts[0].lower() in ("double",) and self.cur.kind == "ident" and self.cur.text.lower() == "precision":
+            self.advance()
+        if self.accept_op("("):
+            inner = [self.advance().text]
+            while self.accept_op(","):
+                inner.append(self.advance().text)
+            self.expect_op(")")
+            parts[0] += f"({','.join(inner)})"
+        return parts[0]
+
+    def expect_type_word(self) -> str:
+        t = self.cur
+        if t.kind in ("ident",) or (t.kind == "kw" and t.text in ("date", "timestamp")):
+            return self.advance().text
+        self.fail("expected type name")
+
+
+def parse_statement(sql: str) -> ast.Statement:
+    p = _Parser(sql.strip().rstrip(";"))
+    stmt = p.parse_statement()
+    if p.cur.kind != "eof":
+        p.fail("unexpected trailing input")
+    return stmt
+
+
+def parse_query(sql: str) -> ast.Query:
+    stmt = parse_statement(sql)
+    if not isinstance(stmt, ast.QueryStatement):
+        raise ParseError("expected a query")
+    return stmt.query

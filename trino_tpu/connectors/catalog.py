@@ -1,0 +1,54 @@
+"""Catalog registry: name -> Connector instance.
+
+Mirrors core/trino-main's catalog management (connector/StaticCatalogManager.
+java + metadata resolution in metadata/MetadataManager) in miniature: a
+session references one default catalog; qualified names pick others.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..spi.connector import Connector, TableSchema
+
+__all__ = ["Catalog", "default_catalog"]
+
+
+class Catalog:
+    def __init__(self):
+        self._connectors: dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._connectors[name] = connector
+
+    def connector(self, name: str) -> Connector:
+        if name not in self._connectors:
+            raise KeyError(f"catalog not found: {name!r}")
+        return self._connectors[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._connectors)
+
+    def resolve_table(self, name: str, default: str) -> tuple[str, str, TableSchema]:
+        """'table' or 'catalog.table' -> (catalog, table, schema)."""
+        parts = name.split(".")
+        if len(parts) == 1:
+            cat, table = default, parts[0]
+        elif len(parts) == 2:
+            cat, table = parts
+        else:  # catalog.schema.table — schema namespaces are a later round
+            cat, table = parts[0], parts[-1]
+        schema = self.connector(cat).get_table_schema(table)
+        return cat, table, schema
+
+
+def default_catalog(scale_factor: float = 0.01) -> Catalog:
+    """Catalog with the standard engine-support connectors registered."""
+    from .memory import BlackholeConnector, MemoryConnector
+    from .tpch import TpchConnector
+
+    cat = Catalog()
+    cat.register("tpch", TpchConnector(scale_factor))
+    cat.register("memory", MemoryConnector())
+    cat.register("blackhole", BlackholeConnector())
+    return cat

@@ -1,0 +1,160 @@
+"""In-memory table connector + /dev/null connector.
+
+Mirror ``plugin/trino-memory`` (MemoryConnector — the v1 write target) and
+``plugin/trino-blackhole`` (BlackHoleConnector — perf-test sink).  Tables live
+as lists of ColumnBatches on the host; splits partition the batch list so
+multi-split scans exercise the same paths as the generator connector.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..spi.batch import ColumnBatch
+from ..spi.connector import (
+    Connector,
+    ConnectorPageSink,
+    ConnectorPageSource,
+    Split,
+    TableSchema,
+    TableStatistics,
+)
+
+__all__ = ["MemoryConnector", "BlackholeConnector"]
+
+
+class _ListPageSource(ConnectorPageSource):
+    def __init__(self, batches: list[ColumnBatch], columns: Sequence[str]):
+        self._batches = batches
+        self._columns = list(columns)
+        self._i = 0
+
+    def get_next_batch(self) -> Optional[ColumnBatch]:
+        if self._i >= len(self._batches):
+            return None
+        b = self._batches[self._i]
+        self._i += 1
+        return b.select(self._columns)
+
+    def is_finished(self) -> bool:
+        return self._i >= len(self._batches)
+
+
+class _MemoryPageSink(ConnectorPageSink):
+    def __init__(self, connector: "MemoryConnector", table: str):
+        self._connector = connector
+        self._table = table
+        self._staged: list[ColumnBatch] = []
+
+    def append(self, batch: ColumnBatch) -> bool:
+        self._staged.append(batch)
+        return True
+
+    def finish(self) -> list[Any]:
+        return [self._staged]
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._schemas: dict[str, TableSchema] = {}
+        self._data: dict[str, list[ColumnBatch]] = {}
+
+    def list_tables(self) -> list[str]:
+        with self._lock:
+            return sorted(self._schemas)
+
+    def get_table_schema(self, table: str) -> TableSchema:
+        with self._lock:
+            if table not in self._schemas:
+                raise KeyError(f"memory: no such table {table!r}")
+            return self._schemas[table]
+
+    def get_table_statistics(self, table: str) -> TableStatistics:
+        with self._lock:
+            rows = sum(b.num_rows for b in self._data.get(table, []))
+        return TableStatistics(row_count=float(rows))
+
+    def create_table(self, schema: TableSchema) -> None:
+        with self._lock:
+            if schema.name in self._schemas:
+                raise ValueError(f"memory: table {schema.name!r} already exists")
+            self._schemas[schema.name] = schema
+            self._data[schema.name] = []
+
+    def drop_table(self, table: str) -> None:
+        with self._lock:
+            self._schemas.pop(table, None)
+            self._data.pop(table, None)
+
+    def get_splits(self, table: str, splits_per_node: int, node_count: int) -> list[Split]:
+        with self._lock:
+            n = len(self._data.get(table, []))
+        want = max(1, splits_per_node * node_count)
+        n_splits = min(want, max(n, 1))
+        bounds = np.linspace(0, n, n_splits + 1, dtype=np.int64)
+        return [
+            Split("memory", table, (int(bounds[i]), int(bounds[i + 1])))
+            for i in range(n_splits)
+            if bounds[i + 1] > bounds[i] or n == 0 and i == 0
+        ]
+
+    def create_page_source(self, split: Split, columns: Sequence[str]) -> ConnectorPageSource:
+        lo, hi = split.info
+        with self._lock:
+            batches = self._data[split.table][lo:hi]
+        return _ListPageSource(batches, columns)
+
+    def create_page_sink(self, table: str) -> ConnectorPageSink:
+        self.get_table_schema(table)  # existence check
+        return _MemoryPageSink(self, table)
+
+    def finish_insert(self, table: str, fragments: list[Any]) -> None:
+        with self._lock:
+            for staged in fragments:
+                self._data[table].extend(staged)
+
+
+class _NullSink(ConnectorPageSink):
+    def __init__(self):
+        self.rows = 0
+
+    def append(self, batch: ColumnBatch) -> bool:
+        self.rows += batch.num_rows
+        return True
+
+    def finish(self) -> list[Any]:
+        return [self.rows]
+
+
+class BlackholeConnector(Connector):
+    name = "blackhole"
+
+    def __init__(self):
+        self._schemas: dict[str, TableSchema] = {}
+
+    def list_tables(self) -> list[str]:
+        return sorted(self._schemas)
+
+    def get_table_schema(self, table: str) -> TableSchema:
+        if table not in self._schemas:
+            raise KeyError(f"blackhole: no such table {table!r}")
+        return self._schemas[table]
+
+    def create_table(self, schema: TableSchema) -> None:
+        self._schemas[schema.name] = schema
+
+    def drop_table(self, table: str) -> None:
+        self._schemas.pop(table, None)
+
+    def get_splits(self, table, splits_per_node, node_count):
+        return []
+
+    def create_page_sink(self, table: str) -> ConnectorPageSink:
+        self.get_table_schema(table)
+        return _NullSink()

@@ -1,0 +1,6 @@
+"""Test support: sqlite correctness oracle, runners, assertion helpers.
+
+Mirrors the reference's ``testing/trino-testing`` module family (H2QueryRunner,
+QueryAssertions, DistributedQueryRunner) — shipped in the package, not tests/,
+so downstream users get the same harness (SURVEY §4).
+"""

@@ -1,0 +1,169 @@
+"""sqlite3-backed correctness oracle.
+
+The reference checks every ``assertQuery(sql)`` against H2 running the same
+statement on the same data (testing/trino-testing/.../H2QueryRunner.java:91,
+QueryAssertions.java:51).  Here the oracle is the stdlib ``sqlite3``: engine
+tables are loaded into sqlite (decimals as REAL, dates as INTEGER epoch-days,
+strings decoded from their dictionaries), the SQL is transpiled for the
+sqlite dialect (date/interval literals and EXTRACT become integer math and
+UDFs), and results are compared as multisets with float tolerance.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import math
+import re
+import sqlite3
+from typing import Iterable, Sequence
+
+from ..spi.batch import ColumnBatch
+from ..spi.types import DATE, days_to_date
+
+__all__ = ["SqliteOracle", "normalize_rows", "assert_same_rows"]
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _to_days(text: str) -> int:
+    return (datetime.date.fromisoformat(text) - _EPOCH).days
+
+
+def _add_months(days: int | None, n: int) -> int | None:
+    if days is None:
+        return None
+    d = _EPOCH + datetime.timedelta(days=days)
+    total = d.year * 12 + (d.month - 1) + n
+    y, m = divmod(total, 12)
+    m += 1
+    # clamp to end of month
+    if m == 12:
+        last = 31
+    else:
+        last = (datetime.date(y, m + 1, 1) - datetime.timedelta(days=1)).day
+    return (datetime.date(y, m, min(d.day, last)) - _EPOCH).days
+
+
+def _year(days):
+    return None if days is None else (_EPOCH + datetime.timedelta(days=days)).year
+
+
+def _month(days):
+    return None if days is None else (_EPOCH + datetime.timedelta(days=days)).month
+
+
+def _quarter(days):
+    return None if days is None else (_month(days) + 2) // 3
+
+
+def transpile(sql: str) -> str:
+    """Rewrite engine SQL into sqlite dialect (dates are INTEGER days)."""
+    out = sql
+    # date literal +- interval  =>  computed integer / add_months()
+    out = re.sub(r"(?i)\bdate\s*'(\d{4}-\d\d-\d\d)'", lambda m: str(_to_days(m.group(1))), out)
+
+    def interval_repl(m):
+        lhs, op, n, unit = m.group(1), m.group(2), int(m.group(3)), m.group(4).lower()
+        if op == "-":
+            n = -n
+        if unit == "day":
+            return f"({lhs} + {n})"
+        months = n * (12 if unit == "year" else 1)
+        return f"add_months({lhs}, {months})"
+
+    prev = None
+    while prev != out:
+        prev = out
+        out = re.sub(
+            r"(?is)([\w.]+|\([^()]*\)|\d+)\s*([+-])\s*interval\s*'(\d+)'\s*(day|month|year)",
+            interval_repl,
+            out,
+        )
+    out = re.sub(r"(?is)extract\s*\(\s*year\s+from\s+", "tpch_year(", out)
+    out = re.sub(r"(?is)extract\s*\(\s*month\s+from\s+", "tpch_month(", out)
+    out = re.sub(r"(?is)extract\s*\(\s*quarter\s+from\s+", "tpch_quarter(", out)
+    out = re.sub(r"(?i)\bsubstring\s*\(", "substr(", out)
+    return out
+
+
+class SqliteOracle:
+    def __init__(self):
+        self.db = sqlite3.connect(":memory:")
+        self.db.create_function("add_months", 2, _add_months, deterministic=True)
+        self.db.create_function("tpch_year", 1, _year, deterministic=True)
+        self.db.create_function("tpch_month", 1, _month, deterministic=True)
+        self.db.create_function("tpch_quarter", 1, _quarter, deterministic=True)
+
+    def load_table(self, name: str, batches: Iterable[ColumnBatch]) -> None:
+        batches = list(batches)
+        first = batches[0]
+        cols = ", ".join(f'"{c}"' for c in first.names)
+        self.db.execute(f'create table "{name}" ({cols})')
+        placeholders = ", ".join("?" * first.num_columns)
+        for b in batches:
+            rows = []
+            for row in b.to_pylist():
+                rows.append(tuple(_to_sqlite(v) for v in row))
+            self.db.executemany(f'insert into "{name}" values ({placeholders})', rows)
+        self.db.commit()
+
+    def query(self, sql: str) -> list[tuple]:
+        return list(self.db.execute(transpile(sql)))
+
+
+def _to_sqlite(v):
+    if isinstance(v, decimal.Decimal):
+        return float(v)
+    if isinstance(v, datetime.date):
+        return (v - _EPOCH).days
+    return v
+
+
+def normalize_rows(rows: Sequence[tuple], float_digits: int = 2) -> list[tuple]:
+    """Normalize to comparable form: dates -> epoch days, Decimal/float ->
+    rounded float, None kept."""
+    out = []
+    for row in rows:
+        norm = []
+        for v in row:
+            if isinstance(v, datetime.date):
+                norm.append((v - _EPOCH).days)
+            elif isinstance(v, decimal.Decimal):
+                norm.append(round(float(v), float_digits))
+            elif isinstance(v, float):
+                if math.isnan(v):
+                    norm.append("NaN")
+                else:
+                    norm.append(round(v, float_digits))
+            elif isinstance(v, bool):
+                norm.append(int(v))
+            else:
+                norm.append(v)
+        out.append(tuple(norm))
+    return out
+
+
+def assert_same_rows(actual: Sequence[tuple], expected: Sequence[tuple],
+                     ordered: bool = False, float_digits: int = 2) -> None:
+    a = normalize_rows(actual, float_digits)
+    e = normalize_rows(expected, float_digits)
+    if not ordered:
+        key = lambda r: tuple((x is None, str(type(x)), x) for x in r)  # noqa: E731
+        a = sorted(a, key=key)
+        e = sorted(e, key=key)
+    assert len(a) == len(e), f"row count {len(a)} != expected {len(e)}\nactual head: {a[:5]}\nexpected head: {e[:5]}"
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        assert _row_eq(ra, re_), f"row {i} differs:\n  actual   {ra}\n  expected {re_}"
+
+
+def _row_eq(a: tuple, b: tuple) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, float) and isinstance(y, float):
+            if not math.isclose(x, y, rel_tol=1e-6, abs_tol=1e-2):
+                return False
+        elif x != y:
+            return False
+    return True

@@ -1,0 +1,3 @@
+"""Planner: logical plan nodes, AST->plan translation, optimizer rules,
+fragmenter.  Re-expresses core/trino-main's sql/planner (66 node types,
+228 iterative rules) as a deliberately small, growable rule set."""

@@ -1,0 +1,733 @@
+"""AST -> logical plan.
+
+The role of sql/planner/LogicalPlanner + QueryPlanner/RelationPlanner
+(reference: sql/planner/LogicalPlanner.java:237 ``plan``, QueryPlanner.java,
+RelationPlanner.java) including subquery planning: correlated scalar
+aggregates, EXISTS and IN become joins/semi-joins here (Trino models them as
+ApplyNode + TransformCorrelated* rules; we decorrelate directly while
+translating, producing the same join shapes).
+
+Channel discipline: every relation's fields map 1:1 to its plan node's output
+channels; appends (subquery marks, scalar results) only ever add channels on
+the right, so previously translated IR stays valid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from ..connectors.catalog import Catalog
+from ..spi.types import BIGINT, BOOLEAN, Type, UNKNOWN
+from ..sql import ast
+from ..sql.analyzer import (
+    AGG_FUNCTIONS,
+    AggregateCollector,
+    AnalysisError,
+    Field,
+    Scope,
+    Translator,
+    agg_result_type,
+    cast_to,
+    rewrite_expr,
+    split_conjuncts,
+)
+from ..sql.ir import Call, InputRef, Literal, OuterRef, RowExpression, walk
+from .plan import (
+    AggCall,
+    Aggregate,
+    Filter,
+    Join,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    SemiJoin,
+    Sort,
+    SortKey,
+    TableScan,
+    TableWriter,
+    TopN,
+    Values,
+)
+
+__all__ = ["LogicalPlanner", "RelationPlan"]
+
+
+@dataclass
+class RelationPlan:
+    node: PlanNode
+    qualifiers: list[Optional[str]]
+
+    def scope(self, parent: Optional[Scope] = None) -> Scope:
+        return Scope(
+            [
+                Field(n, t, q)
+                for n, t, q in zip(
+                    self.node.output_names, self.node.output_types, self.qualifiers
+                )
+            ],
+            parent,
+        )
+
+    @property
+    def width(self) -> int:
+        return len(self.node.output_names)
+
+    def append(self, exprs: list[RowExpression], names: list[str],
+               quals: Optional[list[Optional[str]]] = None) -> "RelationPlan":
+        """Identity projection plus extra computed channels on the right."""
+        base = [
+            InputRef(t, i) for i, t in enumerate(self.node.output_types)
+        ]
+        node = Project(
+            tuple(self.node.output_names) + tuple(names),
+            tuple(self.node.output_types) + tuple(e.type for e in exprs),
+            self.node,
+            tuple(base + exprs),
+        )
+        return RelationPlan(node, self.qualifiers + (quals or [None] * len(exprs)))
+
+
+def _has_outer(e: RowExpression, level: int = 1) -> bool:
+    return any(isinstance(x, OuterRef) and x.level >= level for x in walk(e))
+
+
+def _shift_outer(e: RowExpression, by: int = -1) -> RowExpression:
+    """Decrement OuterRef levels (when an expression moves one scope out)."""
+    if isinstance(e, OuterRef):
+        if e.level + by <= 0:
+            return InputRef(e.type, e.index)
+        return OuterRef(e.type, e.index, e.level + by)
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(_shift_outer(a, by) for a in e.args))
+    return e
+
+
+def _shift_inputs(e: RowExpression, by: int) -> RowExpression:
+    if isinstance(e, InputRef):
+        return InputRef(e.type, e.index + by)
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(_shift_inputs(a, by) for a in e.args))
+    return e
+
+
+def _conjoin(terms: list[RowExpression]) -> Optional[RowExpression]:
+    terms = [t for t in terms if t is not None]
+    if not terms:
+        return None
+    if len(terms) == 1:
+        return terms[0]
+    return Call(BOOLEAN, "$and", tuple(terms))
+
+
+class LogicalPlanner:
+    def __init__(self, catalog: Catalog, default_catalog: str = "tpch"):
+        self.catalog = catalog
+        self.default_catalog = default_catalog
+
+    # ------------------------------------------------------------------ api
+    def plan(self, stmt: ast.Statement) -> PlanNode:
+        if isinstance(stmt, ast.QueryStatement):
+            rel = self.plan_query(stmt.query, None, {})
+            return Output(self.node_names(rel), rel.node.output_types, rel.node)
+        if isinstance(stmt, ast.CreateTableAsSelect) or isinstance(stmt, ast.InsertInto):
+            rel = self.plan_query(stmt.query, None, {})
+            cat, table = self._split_table_name(stmt.table)
+            writer = TableWriter(("rows",), (BIGINT,), rel.node, cat, table)
+            return Output(("rows",), (BIGINT,), writer)
+        raise AnalysisError(f"unsupported statement: {type(stmt).__name__}")
+
+    def node_names(self, rel: RelationPlan) -> tuple[str, ...]:
+        return tuple(rel.node.output_names)
+
+    def _split_table_name(self, name: str) -> tuple[str, str]:
+        parts = name.split(".")
+        if len(parts) == 1:
+            return self.default_catalog, parts[0]
+        return parts[0], parts[-1]
+
+    # ---------------------------------------------------------------- query
+    def plan_query(self, q: ast.Query, outer: Optional[Scope],
+                   ctes: dict[str, ast.Query]) -> RelationPlan:
+        ctes = dict(ctes)
+        for w in q.with_:
+            if w.column_names:
+                ctes[w.name] = replace(
+                    w.query,
+                    body=replace(
+                        w.query.body,
+                        select=tuple(
+                            replace(s, alias=cn)
+                            for s, cn in zip(w.query.body.select, w.column_names)
+                        ),
+                    ),
+                )
+            else:
+                ctes[w.name] = w.query
+        rel, select_irs = self.plan_spec(q.body, outer, ctes)
+
+        # ORDER BY / LIMIT over the projected relation
+        if q.order_by:
+            keys = []
+            for item in q.order_by:
+                ch = self._order_channel(item.expr, q.body, rel, select_irs, outer, ctes)
+                nf = item.nulls_first
+                if nf is None:
+                    nf = not item.ascending  # SQL default: NULLS LAST asc
+                keys.append(SortKey(ch, item.ascending, nf))
+            if q.limit is not None:
+                node = TopN(rel.node.output_names, rel.node.output_types,
+                            rel.node, q.limit, tuple(keys))
+            else:
+                node = Sort(rel.node.output_names, rel.node.output_types,
+                            rel.node, tuple(keys))
+            rel = RelationPlan(node, rel.qualifiers)
+        elif q.limit is not None:
+            rel = RelationPlan(
+                Limit(rel.node.output_names, rel.node.output_types, rel.node, q.limit),
+                rel.qualifiers,
+            )
+        return rel
+
+    def _order_channel(self, e: ast.Expr, spec: ast.QuerySpec, rel: RelationPlan,
+                       select_irs: list[RowExpression], outer, ctes) -> int:
+        # 1) name matches a select alias/output name
+        if isinstance(e, ast.ColumnRef) and len(e.parts) == 1:
+            names = rel.node.output_names
+            if names.count(e.parts[0]) == 1:
+                return names.index(e.parts[0])
+        # 2) expression equal to a select item (translated in the same context)
+        if isinstance(e, ast.IntLiteral):  # ORDER BY ordinal
+            if 1 <= e.value <= len(select_irs):
+                return e.value - 1
+        tr = self._select_context_translator(spec, outer, ctes)
+        if tr is not None:
+            try:
+                ir = tr(e)
+            except AnalysisError:
+                ir = None
+            if ir is not None and ir in select_irs:
+                return select_irs.index(ir)
+        raise AnalysisError(f"ORDER BY expression not in select list: {e}")
+
+    def _select_context_translator(self, spec, outer, ctes):
+        ctx = getattr(self, "_last_select_ctx", None)
+        if ctx is None or ctx[0] is not spec:
+            return None
+        _, translate = ctx
+        return translate
+
+    # ----------------------------------------------------------------- spec
+    def plan_spec(self, spec: ast.QuerySpec, outer: Optional[Scope],
+                  ctes: dict[str, ast.Query]) -> tuple[RelationPlan, list[RowExpression]]:
+        rel = (self.plan_relation(spec.from_, outer, ctes)
+               if spec.from_ is not None
+               else RelationPlan(Values((), (), rows=((),)), []))
+        # capture the user-visible fields now: WHERE subquery handling appends
+        # synthetic channels (_mark/_scalar/_key) that SELECT * must not see
+        star_width = rel.width
+
+        # WHERE: plain conjuncts first (push down), then subquery conjuncts
+        if spec.where is not None:
+            conjuncts = split_conjuncts(spec.where)
+            plain = [c for c in conjuncts if not _contains_subquery(c)]
+            subq = [c for c in conjuncts if _contains_subquery(c)]
+            if plain:
+                tr = Translator(rel.scope(outer))
+                pred = _conjoin([cast_to(tr.translate(c), BOOLEAN) for c in plain])
+                rel = RelationPlan(
+                    Filter(rel.node.output_names, rel.node.output_types, rel.node, pred),
+                    rel.qualifiers,
+                )
+            for c in subq:
+                rel = self._plan_subquery_conjunct(rel, c, outer, ctes)
+
+        has_group = bool(spec.group_by)
+        collector = AggregateCollector()
+        rewrite: dict[RowExpression, RowExpression] = {}
+        scope = rel.scope(outer)
+        tr = Translator(scope, aggregates=collector)
+        select_items = self._expand_stars(spec, rel, star_width)
+        select_irs = [tr.translate(it.expr) for it in select_items]
+        having_ir = None
+        having_subqueries: list[tuple[ast.Expr, RelationPlan]] = []
+        if spec.having is not None:
+            # two-phase: translate now against the pre-agg scope (collecting
+            # aggregates); subqueries become $subq markers planned standalone
+            # for their type, attached above the Aggregate afterwards
+            def stash_cb(node: ast.Expr) -> RowExpression:
+                if isinstance(node, ast.ScalarSubquery):
+                    sub = self.plan_query(node.query, None, ctes)
+                    if sub.width != 1:
+                        raise AnalysisError("scalar subquery must return one column")
+                    having_subqueries.append((node, sub))
+                    return Call(sub.node.output_types[0], "$subq",
+                                (Literal(BIGINT, len(having_subqueries) - 1),))
+                raise AnalysisError(
+                    f"unsupported subquery in HAVING: {type(node).__name__}")
+
+            htr = Translator(scope, aggregates=collector, subquery_cb=stash_cb)
+            having_ir = _conjoin(
+                [cast_to(htr.translate(c), BOOLEAN)
+                 for c in split_conjuncts(spec.having)])
+
+        has_aggs = bool(collector.calls)
+        if has_group or has_aggs:
+            group_irs = [Translator(rel.scope(outer)).translate(g)
+                         for g in spec.group_by]
+            rel, rewrite = self._plan_aggregation(rel, group_irs, collector, outer)
+            select_irs = [rewrite_expr(e, rewrite) for e in select_irs]
+            if having_ir is not None:
+                having_ir = rewrite_expr(having_ir, rewrite)
+                # attach stashed HAVING subqueries above the Aggregate
+                for i, (node, sub) in enumerate(having_subqueries):
+                    names = tuple(rel.node.output_names) + (f"_scalar{rel.width}",)
+                    types = tuple(rel.node.output_types) + (sub.node.output_types[0],)
+                    jn = Join(names, types, rel.node, sub.node, "SINGLE", (), (), None)
+                    rel = RelationPlan(jn, rel.qualifiers + [None])
+                    marker = Call(sub.node.output_types[0], "$subq",
+                                  (Literal(BIGINT, i),))
+                    having_ir = rewrite_expr(
+                        having_ir,
+                        {marker: InputRef(types[-1], rel.width - 1)})
+                rel = RelationPlan(
+                    Filter(rel.node.output_names, rel.node.output_types,
+                           rel.node, having_ir),
+                    rel.qualifiers,
+                )
+        elif spec.having is not None:
+            raise AnalysisError("HAVING requires aggregation")
+
+        # SELECT projection
+        names = []
+        for i, it in enumerate(select_items):
+            if it.alias:
+                names.append(it.alias)
+            elif isinstance(it.expr, ast.ColumnRef):
+                names.append(it.expr.parts[-1])
+            else:
+                names.append(f"_col{i}")
+        # validate: no leftover raw column refs when aggregated
+        if has_group or has_aggs:
+            allowed = set(range(rel.width))
+            for e in select_irs:
+                for x in walk(e):
+                    if isinstance(x, InputRef) and x.index not in allowed:
+                        raise AnalysisError(
+                            "expression must appear in GROUP BY or be aggregated")
+        proj = Project(tuple(names), tuple(e.type for e in select_irs),
+                       rel.node, tuple(select_irs))
+        out = RelationPlan(proj, [None] * len(names))
+        if spec.distinct:
+            agg = Aggregate(proj.output_names, proj.output_types, proj,
+                            tuple(range(len(names))), ())
+            out = RelationPlan(agg, [None] * len(names))
+
+        # stash context for ORDER BY expression matching
+        def translate_in_select_ctx(e: ast.Expr) -> RowExpression:
+            t = Translator(scope, aggregates=collector)
+            ir = t.translate(e)
+            if has_group or has_aggs:
+                ir = rewrite_expr(ir, rewrite)
+            return ir
+
+        self._last_select_ctx = (spec, translate_in_select_ctx)
+        return out, select_irs
+
+    def _expand_stars(self, spec: ast.QuerySpec, rel: RelationPlan,
+                      star_width: int) -> list[ast.SelectItem]:
+        out = []
+        for it in spec.select:
+            if it.expr is not None:
+                out.append(it)
+                continue
+            for name, qual in list(zip(rel.node.output_names, rel.qualifiers))[:star_width]:
+                if it.star_prefix is None or it.star_prefix == qual:
+                    out.append(ast.SelectItem(ast.ColumnRef((name,)), None))
+        if not out:
+            raise AnalysisError("SELECT * matched no columns")
+        return out
+
+    # ---------------------------------------------------------- aggregation
+    def _plan_aggregation(self, rel: RelationPlan, group_irs, collector, outer):
+        """Pre-project group keys + agg args, emit Aggregate, return rewrite
+        map for post-agg expressions."""
+        pre_exprs: list[RowExpression] = []
+        pre_names: list[str] = []
+
+        def channel_of(e: RowExpression) -> int:
+            if isinstance(e, InputRef):
+                return e.index
+            for j, pe in enumerate(pre_exprs):
+                if pe == e:
+                    return rel.width + j
+            pre_exprs.append(e)
+            pre_names.append(f"_expr{len(pre_exprs)}")
+            return rel.width + len(pre_exprs) - 1
+
+        key_channels = [channel_of(g) for g in group_irs]
+        agg_calls = []
+        for fn, arg, distinct, out_t in collector.calls:
+            ch = channel_of(arg) if arg is not None else -1
+            agg_calls.append(AggCall(fn, ch, out_t, distinct))
+        src = rel
+        if pre_exprs:
+            src = rel.append(pre_exprs, pre_names)
+        names = tuple(
+            [src.node.output_names[c] for c in key_channels]
+            + [f"_agg{j}" for j in range(len(agg_calls))]
+        )
+        types = tuple(
+            [src.node.output_types[c] for c in key_channels]
+            + [a.type for a in agg_calls]
+        )
+        agg = Aggregate(names, types, src.node, tuple(key_channels), tuple(agg_calls))
+        quals = [src.qualifiers[c] for c in key_channels] + [None] * len(agg_calls)
+        out = RelationPlan(agg, quals)
+        rewrite: dict[RowExpression, RowExpression] = {}
+        for i, g in enumerate(group_irs):
+            rewrite[g] = InputRef(g.type, i)
+        for j, (fn, arg, distinct, out_t) in enumerate(collector.calls):
+            placeholder = Call(out_t, "$aggref", (Literal(BIGINT, j),))
+            rewrite[placeholder] = InputRef(out_t, len(key_channels) + j)
+        return out, rewrite
+
+    # ------------------------------------------------------------ relations
+    def plan_relation(self, r: ast.Relation, outer: Optional[Scope],
+                      ctes: dict[str, ast.Query]) -> RelationPlan:
+        if isinstance(r, ast.Table):
+            if r.name in ctes:
+                rel = self.plan_query(ctes[r.name], None, ctes)
+                qual = r.alias or r.name
+                return RelationPlan(rel.node, [qual] * rel.width)
+            cat, table, schema = self.catalog.resolve_table(r.name, self.default_catalog)
+            cols = tuple(c.name for c in schema.columns)
+            types = tuple(c.type for c in schema.columns)
+            node = TableScan(cols, types, cat, table, cols)
+            qual = r.alias or table
+            return RelationPlan(node, [qual] * len(cols))
+        if isinstance(r, ast.SubqueryRelation):
+            rel = self.plan_query(r.query, outer, ctes)
+            return RelationPlan(rel.node, [r.alias] * rel.width)
+        if isinstance(r, ast.Join):
+            return self.plan_join(r, outer, ctes)
+        raise AnalysisError(f"unsupported relation: {type(r).__name__}")
+
+    def plan_join(self, j: ast.Join, outer, ctes) -> RelationPlan:
+        left = self.plan_relation(j.left, outer, ctes)
+        right = self.plan_relation(j.right, outer, ctes)
+        names = tuple(left.node.output_names) + tuple(right.node.output_names)
+        types = tuple(left.node.output_types) + tuple(right.node.output_types)
+        quals = left.qualifiers + right.qualifiers
+        if j.join_type == "CROSS" or j.condition is None:
+            node = Join(names, types, left.node, right.node, "CROSS", (), (), None)
+            return RelationPlan(node, quals)
+        if j.join_type in ("RIGHT", "FULL"):
+            raise AnalysisError(f"{j.join_type} join not yet supported")
+        combined = Scope(
+            [Field(n, t, q) for n, t, q in zip(names, types, quals)], outer)
+        tr = Translator(combined)
+        conjuncts = [cast_to(tr.translate(c), BOOLEAN)
+                     for c in split_conjuncts(j.condition)]
+        lw = left.width
+        lkeys, rkeys, residual = [], [], []
+        for c in conjuncts:
+            sides = _classify_sides(c, lw)
+            if (isinstance(c, Call) and c.name == "eq" and sides == "both"
+                    and _classify_sides(c.args[0], lw) in ("left", "right")
+                    and _classify_sides(c.args[1], lw) in ("left", "right")
+                    and _classify_sides(c.args[0], lw) != _classify_sides(c.args[1], lw)):
+                a, b = c.args
+                if _classify_sides(a, lw) == "right":
+                    a, b = b, a
+                lkeys.append(a)
+                rkeys.append(_shift_inputs(b, -lw))
+            else:
+                residual.append(c)
+        # key expressions must be plain channels: append projections if needed
+        lch, left = _as_channels(lkeys, left)
+        rch, right = _as_channels(rkeys, right)
+        names = tuple(left.node.output_names) + tuple(right.node.output_names)
+        types = tuple(left.node.output_types) + tuple(right.node.output_types)
+        quals = left.qualifiers + right.qualifiers
+        res = _conjoin(residual) if residual else None
+        node = Join(names, types, left.node, right.node, j.join_type,
+                    tuple(lch), tuple(rch), res)
+        return RelationPlan(node, quals)
+
+    # ------------------------------------------------------------ subqueries
+    def _plan_subquery_conjunct(self, rel: RelationPlan, c: ast.Expr, outer, ctes,
+                                agg_rewrite=None) -> RelationPlan:
+        holder = {"rel": rel}
+
+        def cb(node):
+            new_rel, ir = self._handle_subquery(holder["rel"], node, outer, ctes)
+            holder["rel"] = new_rel
+            return ir
+
+        collector = agg_rewrite[0] if agg_rewrite else None
+        tr = Translator(holder["rel"].scope(outer), aggregates=collector,
+                        subquery_cb=cb)
+        ir = cast_to(tr.translate(c), BOOLEAN)
+        if agg_rewrite:
+            ir = rewrite_expr(ir, agg_rewrite[1])
+        out = holder["rel"]
+        return RelationPlan(
+            Filter(out.node.output_names, out.node.output_types, out.node, ir),
+            out.qualifiers,
+        )
+
+    def _handle_subquery(self, rel: RelationPlan, node: ast.Expr, outer, ctes):
+        if isinstance(node, ast.InSubquery):
+            return self._plan_in_subquery(rel, node, outer, ctes)
+        if isinstance(node, ast.Exists):
+            return self._plan_exists(rel, node, outer, ctes)
+        if isinstance(node, ast.ScalarSubquery):
+            return self._plan_scalar_subquery(rel, node, outer, ctes)
+        raise AnalysisError(f"unsupported subquery form: {type(node).__name__}")
+
+    def _plan_in_subquery(self, rel: RelationPlan, node: ast.InSubquery, outer, ctes):
+        sub = self.plan_query(node.query, None, ctes)
+        if sub.width != 1:
+            raise AnalysisError("IN subquery must return one column")
+        operand = Translator(rel.scope(outer)).translate(node.operand)
+        if isinstance(operand, InputRef):
+            src, s_ch = rel, operand.index
+        else:
+            src = rel.append([operand], ["_in_key"])
+            s_ch = src.width - 1
+        mark_name = f"_mark{src.width}"
+        names = tuple(src.node.output_names) + (mark_name,)
+        types = tuple(src.node.output_types) + (BOOLEAN,)
+        sj = SemiJoin(names, types, src.node, sub.node, (s_ch,), (0,),
+                      negated=False, residual=None, null_aware=True)
+        new_rel = RelationPlan(sj, src.qualifiers + [None])
+        mark = InputRef(BOOLEAN, new_rel.width - 1)
+        ir = Call(BOOLEAN, "$not", (mark,)) if node.negated else mark
+        return new_rel, ir
+
+    def _plan_exists(self, rel: RelationPlan, node: ast.Exists, outer, ctes):
+        spec = node.query.body
+        if spec.group_by or spec.having:
+            raise AnalysisError("EXISTS subquery with aggregation not supported")
+        inner = (self.plan_relation(spec.from_, None, ctes)
+                 if spec.from_ is not None else None)
+        if inner is None:
+            raise AnalysisError("EXISTS requires FROM")
+        inner_filters: list[RowExpression] = []
+        corr_pairs: list[tuple[RowExpression, RowExpression]] = []
+        residuals: list[RowExpression] = []
+        if spec.where is not None:
+            scope = inner.scope(rel.scope(outer))
+            tr = Translator(scope)
+            for c in split_conjuncts(spec.where):
+                ir = cast_to(tr.translate(c), BOOLEAN)
+                if not _has_outer(ir):
+                    inner_filters.append(ir)
+                elif (isinstance(ir, Call) and ir.name == "eq"
+                      and _is_outer_only(ir.args[0]) != _is_outer_only(ir.args[1])):
+                    a, b = ir.args
+                    if _is_outer_only(b):
+                        a, b = b, a
+                    # a: outer side, b: inner side
+                    if _has_outer(b):
+                        residuals.append(ir)
+                    else:
+                        corr_pairs.append((_shift_outer(a), b))
+                else:
+                    residuals.append(ir)
+        if inner_filters:
+            pred = _conjoin(inner_filters)
+            inner = RelationPlan(
+                Filter(inner.node.output_names, inner.node.output_types,
+                       inner.node, pred), inner.qualifiers)
+        if not corr_pairs and not residuals:
+            raise AnalysisError("uncorrelated EXISTS not supported yet")
+        src = rel
+        s_chs, f_chs = [], []
+        src_append, inner_append = [], []
+        for outer_e, inner_e in corr_pairs:
+            if isinstance(outer_e, InputRef):
+                s_chs.append(outer_e.index)
+            else:
+                src_append.append(outer_e)
+                s_chs.append(None)
+            if isinstance(inner_e, InputRef):
+                f_chs.append(inner_e.index)
+            else:
+                inner_append.append(inner_e)
+                f_chs.append(None)
+        if src_append:
+            base = src.width
+            src = src.append(src_append, [f"_k{base+i}" for i in range(len(src_append))])
+            it = iter(range(base, base + len(src_append)))
+            s_chs = [c if c is not None else next(it) for c in s_chs]
+        if inner_append:
+            base = inner.width
+            inner = inner.append(inner_append,
+                                 [f"_k{base+i}" for i in range(len(inner_append))])
+            it = iter(range(base, base + len(inner_append)))
+            f_chs = [c if c is not None else next(it) for c in f_chs]
+        residual_ir = None
+        if residuals:
+            # over source channels ++ inner channels
+            sw = src.width
+            def remap(e: RowExpression) -> RowExpression:
+                if isinstance(e, OuterRef) and e.level == 1:
+                    return InputRef(e.type, e.index)
+                if isinstance(e, InputRef):
+                    return InputRef(e.type, e.index + sw)
+                if isinstance(e, Call):
+                    return Call(e.type, e.name, tuple(remap(a) for a in e.args))
+                return e
+            residual_ir = _conjoin([remap(r) for r in residuals])
+        mark_name = f"_mark{src.width}"
+        names = tuple(src.node.output_names) + (mark_name,)
+        types = tuple(src.node.output_types) + (BOOLEAN,)
+        sj = SemiJoin(names, types, src.node, inner.node,
+                      tuple(s_chs), tuple(f_chs), negated=False,
+                      residual=residual_ir, null_aware=False)
+        new_rel = RelationPlan(sj, src.qualifiers + [None])
+        mark = InputRef(BOOLEAN, new_rel.width - 1)
+        ir = Call(BOOLEAN, "$not", (mark,)) if node.negated else mark
+        return new_rel, ir
+
+    def _plan_scalar_subquery(self, rel: RelationPlan, node: ast.ScalarSubquery,
+                              outer, ctes):
+        spec = node.query.body
+        # detect correlation by planning the WHERE against a chained scope
+        corr = self._try_correlated_scalar(rel, node.query, outer, ctes)
+        if corr is not None:
+            return corr
+        sub = self.plan_query(node.query, None, ctes)
+        if sub.width != 1:
+            raise AnalysisError("scalar subquery must return one column")
+        names = tuple(rel.node.output_names) + (f"_scalar{rel.width}",)
+        types = tuple(rel.node.output_types) + (sub.node.output_types[0],)
+        # single-row broadcast join (EnforceSingleRow + cross join in Trino)
+        jn = Join(names, types, rel.node, sub.node, "SINGLE", (), (), None)
+        new_rel = RelationPlan(jn, rel.qualifiers + [None])
+        return new_rel, InputRef(types[-1], new_rel.width - 1)
+
+    def _try_correlated_scalar(self, rel: RelationPlan, q: ast.Query, outer, ctes):
+        spec = q.body
+        if (spec.group_by or spec.having or q.order_by or q.limit is not None
+                or spec.from_ is None or len(spec.select) != 1):
+            return None
+        inner = self.plan_relation(spec.from_, None, ctes)
+        if spec.where is None:
+            return None
+        scope = inner.scope(rel.scope(outer))
+        tr = Translator(scope)
+        inner_filters, corr_pairs = [], []
+        for c in split_conjuncts(spec.where):
+            ir = cast_to(tr.translate(c), BOOLEAN)
+            if not _has_outer(ir):
+                inner_filters.append(ir)
+            elif (isinstance(ir, Call) and ir.name == "eq"
+                  and _is_outer_only(ir.args[0]) != _is_outer_only(ir.args[1])
+                  and not (_has_outer(ir.args[0]) and _has_outer(ir.args[1]))):
+                a, b = ir.args
+                if _is_outer_only(b):
+                    a, b = b, a
+                corr_pairs.append((_shift_outer(a), b))
+            else:
+                raise AnalysisError(f"unsupported correlated predicate: {c}")
+        if not corr_pairs:
+            return None
+        # aggregate the inner by its correlation keys
+        collector = AggregateCollector()
+        sel_tr = Translator(inner.scope(), aggregates=collector)
+        sel_ir = sel_tr.translate(spec.select[0].expr)
+        if not collector.calls:
+            raise AnalysisError("correlated scalar subquery must aggregate")
+        if inner_filters:
+            inner = RelationPlan(
+                Filter(inner.node.output_names, inner.node.output_types,
+                       inner.node, _conjoin(inner_filters)), inner.qualifiers)
+        group_irs = [b for (_, b) in corr_pairs]
+        agg_rel, rewrite = self._plan_aggregation(inner, group_irs, collector, None)
+        value_ir = rewrite_expr(sel_ir, rewrite)
+        nkeys = len(group_irs)
+        value_rel = agg_rel.append([value_ir], ["_scalar_value"])
+        # prune to keys + value
+        keep = list(range(nkeys)) + [value_rel.width - 1]
+        proj = Project(
+            tuple(value_rel.node.output_names[i] for i in keep),
+            tuple(value_rel.node.output_types[i] for i in keep),
+            value_rel.node,
+            tuple(InputRef(value_rel.node.output_types[i], i) for i in keep),
+        )
+        # outer-side keys as channels
+        outer_keys = [a for (a, _) in corr_pairs]
+        och, src = _as_channels(outer_keys, rel)
+        names = tuple(src.node.output_names) + proj.output_names
+        types = tuple(src.node.output_types) + proj.output_types
+        jn = Join(names, types, src.node, proj, "LEFT",
+                  tuple(och), tuple(range(nkeys)), None)
+        new_rel = RelationPlan(jn, src.qualifiers + [None] * (nkeys + 1))
+        return new_rel, InputRef(types[-1], new_rel.width - 1)
+
+
+def _index_of(ir, irs):
+    return irs.index(ir) if ir in irs else None
+
+
+def _contains_subquery(e: ast.Expr) -> bool:
+    if isinstance(e, (ast.InSubquery, ast.Exists, ast.ScalarSubquery)):
+        return True
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, ast.Expr) and _contains_subquery(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, ast.Expr) and _contains_subquery(x):
+                    return True
+                if isinstance(x, ast.WhenClause):
+                    if _contains_subquery(x.condition) or _contains_subquery(x.result):
+                        return True
+    return False
+
+
+def _classify_sides(e: RowExpression, left_width: int) -> str:
+    sides = set()
+    for x in walk(e):
+        if isinstance(x, InputRef):
+            sides.add("left" if x.index < left_width else "right")
+        elif isinstance(x, OuterRef):
+            sides.add("outer")
+    if sides == {"left"}:
+        return "left"
+    if sides == {"right"}:
+        return "right"
+    if not sides:
+        return "none"
+    return "both"
+
+
+def _is_outer_only(e: RowExpression) -> bool:
+    has_outer = False
+    for x in walk(e):
+        if isinstance(x, InputRef):
+            return False
+        if isinstance(x, OuterRef):
+            has_outer = True
+    return has_outer
+
+
+def _as_channels(exprs: list[RowExpression], rel: RelationPlan):
+    """Return ([channel...], possibly-extended relation) for key expressions."""
+    chans = []
+    to_append, names = [], []
+    for e in exprs:
+        if isinstance(e, InputRef):
+            chans.append(e.index)
+        else:
+            chans.append(rel.width + len(to_append))
+            to_append.append(e)
+            names.append(f"_key{rel.width + len(to_append) - 1}")
+    if to_append:
+        rel = rel.append(to_append, names)
+    return chans, rel

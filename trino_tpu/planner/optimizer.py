@@ -1,0 +1,590 @@
+"""Plan optimizer: cross-join flattening + stats-greedy join ordering,
+filter pushdown, join distribution choice, column pruning.
+
+The deliberately small stand-in for sql/planner/PlanOptimizers' 228 iterative
+rules (reference: iterative/rule/ReorderJoins.java,
+DetermineJoinDistributionType.java, PushPredicateIntoTableScan.java,
+PruneUnreferencedOutputs.java).  Rules operate on channel indices, so every
+rewrite returns (new_node, mapping old-channel -> new-channel) and parents
+remap their expressions — the moral equivalent of Trino's symbol mapper.
+
+Join ordering: comma/CROSS-join clusters under a Filter are flattened into a
+join graph; the spine starts at the largest estimated relation and greedily
+joins the smallest connected relation next (build sides stay small); every
+available equality edge becomes a hash-join key, including cycle-closing
+edges (Q5's c_nationkey = s_nationkey).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional
+
+from ..connectors.catalog import Catalog
+from ..spi.types import BOOLEAN
+from ..sql.ir import Call, InputRef, Literal, RowExpression, walk
+from .plan import (
+    Aggregate,
+    Exchange,
+    Filter,
+    Join,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    SemiJoin,
+    Sort,
+    TableScan,
+    TableWriter,
+    TopN,
+    Values,
+)
+
+__all__ = ["optimize", "estimate_rows"]
+
+_BROADCAST_LIMIT = 2_000_000  # build rows below this replicate to every task
+
+
+def optimize(root: PlanNode, catalog: Catalog) -> PlanNode:
+    node, mapping = _rewrite(root, catalog)
+    assert mapping == list(range(len(node.output_types))), "root remap escaped"
+    node = _prune(node, set(range(len(node.output_types))))[0]
+    return node
+
+
+# --------------------------------------------------------------------------
+# helpers
+
+
+def _remap_expr(e: RowExpression, mapping: list[Optional[int]]) -> RowExpression:
+    if isinstance(e, InputRef):
+        new = mapping[e.index]
+        assert new is not None, f"channel #{e.index} pruned but referenced"
+        return InputRef(e.type, new)
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(_remap_expr(a, mapping) for a in e.args))
+    return e
+
+
+def _refs(e: RowExpression) -> set[int]:
+    return {x.index for x in walk(e) if isinstance(x, InputRef)}
+
+
+def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
+    if isinstance(node, TableScan):
+        stats = catalog.connector(node.catalog).get_table_statistics(node.table)
+        r = stats.row_count
+        return r if r == r else 10_000.0  # NaN check
+    if isinstance(node, Filter):
+        n = len(node.predicate.args) if (
+            isinstance(node.predicate, Call) and node.predicate.name == "$and"
+        ) else 1
+        return estimate_rows(node.source, catalog) * (0.3 ** n)
+    if isinstance(node, Project):
+        return estimate_rows(node.source, catalog)
+    if isinstance(node, Aggregate):
+        src = estimate_rows(node.source, catalog)
+        return max(1.0, src * 0.1) if node.group_keys else 1.0
+    if isinstance(node, Join):
+        l = estimate_rows(node.left, catalog)
+        r = estimate_rows(node.right, catalog)
+        if not node.left_keys:
+            return l * r if node.join_type == "CROSS" else l
+        return max(l, r)
+    if isinstance(node, SemiJoin):
+        return estimate_rows(node.source, catalog)
+    if isinstance(node, (Sort,)):
+        return estimate_rows(node.source, catalog)
+    if isinstance(node, (TopN, Limit)):
+        return float(getattr(node, "count", 1000))
+    if isinstance(node, Values):
+        return float(len(node.rows))
+    for c in node.children:
+        return estimate_rows(c, catalog)
+    return 1000.0
+
+
+# --------------------------------------------------------------------------
+# main rewrite (returns node + channel mapping old->new)
+
+
+def _identity(node: PlanNode) -> list[int]:
+    return list(range(len(node.output_types)))
+
+
+def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
+    if isinstance(node, Filter):
+        return _rewrite_filter_cluster(node, catalog)
+    if isinstance(node, Join) and node.join_type in ("CROSS", "INNER"):
+        return _rewrite_filter_cluster(node, catalog)
+
+    if isinstance(node, (Output,)):
+        child, m = _rewrite(node.source, catalog)
+        if m != list(range(len(child.output_types))):
+            child = _restore_layout(child, m, node.source)
+        return replace(node, source=child), _identity(node)
+
+    if isinstance(node, Project):
+        child, m = _rewrite(node.source, catalog)
+        exprs = tuple(_remap_expr(e, m) for e in node.expressions)
+        return replace(node, source=child, expressions=exprs), _identity(node)
+
+    if isinstance(node, Aggregate):
+        child, m = _rewrite(node.source, catalog)
+        return (
+            replace(
+                node,
+                source=child,
+                group_keys=tuple(m[k] for k in node.group_keys),
+                aggregates=tuple(
+                    replace(a, arg=m[a.arg] if a.arg >= 0 else -1)
+                    for a in node.aggregates
+                ),
+            ),
+            _identity(node),
+        )
+
+    if isinstance(node, Join):  # LEFT / SINGLE
+        left, lm = _rewrite(node.left, catalog)
+        right, rm = _rewrite(node.right, catalog)
+        lw_old = len(node.left.output_types)
+        lw_new = len(left.output_types)
+        mapping = [lm[i] for i in range(lw_old)] + [rm[i - lw_old] + lw_new
+                                                   for i in range(lw_old, lw_old + len(rm))]
+        names = tuple(left.output_names) + tuple(right.output_names)
+        types = tuple(left.output_types) + tuple(right.output_types)
+        residual = (_remap_expr(node.residual, mapping)
+                    if node.residual is not None else None)
+        out = replace(
+            node, output_names=names, output_types=types, left=left, right=right,
+            left_keys=tuple(lm[k] for k in node.left_keys),
+            right_keys=tuple(rm[k] for k in node.right_keys),
+            residual=residual,
+            distribution=_choose_distribution(right, catalog),
+        )
+        return out, mapping
+
+    if isinstance(node, SemiJoin):
+        src, sm = _rewrite(node.source, catalog)
+        filt, fm = _rewrite(node.filter_source, catalog)
+        sw_old = len(node.source.output_types)
+        sw_new = len(src.output_types)
+        mapping = [sm[i] for i in range(sw_old)] + [sw_new]  # mark at end
+        residual = None
+        if node.residual is not None:
+            # residual layout: source ++ filter channels
+            rmap = sm + [fm[i] + sw_new for i in range(len(fm))]
+            residual = _remap_expr(node.residual, rmap)
+        names = tuple(src.output_names) + (node.output_names[-1],)
+        types = tuple(src.output_types) + (BOOLEAN,)
+        out = replace(
+            node, output_names=names, output_types=types,
+            source=src, filter_source=filt,
+            source_keys=tuple(sm[k] for k in node.source_keys),
+            filter_keys=tuple(fm[k] for k in node.filter_keys),
+            residual=residual,
+        )
+        return out, mapping
+
+    if isinstance(node, (Sort, TopN, Limit, TableWriter, Exchange)):
+        child, m = _rewrite(node.source, catalog)
+        kwargs = dict(source=child, output_names=child.output_names,
+                      output_types=child.output_types)
+        if isinstance(node, (Sort, TopN)):
+            kwargs["keys"] = tuple(replace(k, channel=m[k.channel]) for k in node.keys)
+        if isinstance(node, Exchange):
+            kwargs["partition_keys"] = tuple(m[k] for k in node.partition_keys)
+        return replace(node, **kwargs), m
+
+    if isinstance(node, (TableScan, Values)):
+        return node, _identity(node)
+
+    raise NotImplementedError(f"optimizer: {type(node).__name__}")
+
+
+def _restore_layout(child: PlanNode, mapping: list[int], original: PlanNode) -> PlanNode:
+    exprs = tuple(InputRef(t, mapping[i]) for i, t in enumerate(original.output_types))
+    return Project(tuple(original.output_names), tuple(original.output_types),
+                   child, exprs)
+
+
+def _choose_distribution(build: PlanNode, catalog: Catalog) -> str:
+    return ("BROADCAST" if estimate_rows(build, catalog) <= _BROADCAST_LIMIT
+            else "PARTITIONED")
+
+
+# --------------------------------------------------------------------------
+# cross-join cluster flattening
+
+
+def _shift(e: RowExpression, by: int) -> RowExpression:
+    if isinstance(e, InputRef):
+        return InputRef(e.type, e.index + by)
+    if isinstance(e, Call):
+        return Call(e.type, e.name, tuple(_shift(a, by) for a in e.args))
+    return e
+
+
+def _flatten(node: PlanNode, catalog: Catalog):
+    """Collect cluster leaves with their ORIGINAL channel offsets."""
+    leaves: list[tuple[PlanNode, list[int]]] = []
+    conjuncts: list[RowExpression] = []
+
+    def go(n: PlanNode, offset: int) -> int:
+        """Returns width of n's original layout; appends leaves/conjuncts."""
+        if isinstance(n, Join) and n.join_type in ("CROSS", "INNER"):
+            lw = go(n.left, offset)
+            rw = go(n.right, offset + lw)
+            for lk, rk in zip(n.left_keys, n.right_keys):
+                conjuncts.append(Call(BOOLEAN, "eq", (
+                    InputRef(n.left.output_types[lk], offset + lk),
+                    InputRef(n.right.output_types[rk], offset + lw + rk))))
+            if n.residual is not None:
+                conjuncts.append(_shift(n.residual, offset))
+            return lw + rw
+        leaf, m = _rewrite(n, catalog)
+        leaves.append((leaf, offset, m))
+        return len(n.output_types)
+
+    total = go(node, 0)
+    return leaves, conjuncts, total
+
+
+def _rewrite_filter_cluster(node: PlanNode, catalog: Catalog):
+    if isinstance(node, Filter):
+        cluster_root = node.source
+        preds = _split_and(node.predicate)
+    else:
+        cluster_root = node
+        preds = []
+    if not (isinstance(cluster_root, Join)
+            and cluster_root.join_type in ("CROSS", "INNER")):
+        # plain filter over a non-join child
+        child, m = _rewrite(cluster_root, catalog)
+        if not isinstance(node, Filter):
+            return child, m
+        pred = _conjoin([_remap_expr(p, m) for p in preds])
+        out = Filter(child.output_names, child.output_types, child, pred)
+        return out, m
+
+    leaves, conjuncts, total_width = _flatten(cluster_root, catalog)
+    conjuncts = conjuncts + preds
+
+    # original channel -> (leaf idx, local channel through leaf's mapping)
+    chan_leaf: dict[int, tuple[int, int]] = {}
+    for li, (leaf, offset, m) in enumerate(leaves):
+        for local_old, local_new in enumerate(m):
+            chan_leaf[offset + local_old] = (li, local_new)
+
+    def leaf_of(e: RowExpression) -> Optional[int]:
+        ls = {chan_leaf[i][0] for i in _refs(e)}
+        return ls.pop() if len(ls) == 1 else None
+
+    # push single-leaf conjuncts into the leaf
+    leaf_nodes = [leaf for (leaf, _, _) in leaves]
+    leaf_filters: list[list[RowExpression]] = [[] for _ in leaves]
+    edges: list[tuple[int, int, RowExpression, RowExpression]] = []
+    residual: list[RowExpression] = []
+    for c in conjuncts:
+        refs = _refs(c)
+        involved = {chan_leaf[i][0] for i in refs}
+        if len(involved) == 1:
+            li = involved.pop()
+            local = _remap_to_leaf(c, chan_leaf, li)
+            leaf_filters[li].append(local)
+        elif (isinstance(c, Call) and c.name == "eq" and len(involved) == 2
+              and _single_leaf(c.args[0], chan_leaf) is not None
+              and _single_leaf(c.args[1], chan_leaf) is not None):
+            a, b = c.args
+            la, lb = _single_leaf(a, chan_leaf), _single_leaf(b, chan_leaf)
+            edges.append((la, lb,
+                          _remap_to_leaf(a, chan_leaf, la),
+                          _remap_to_leaf(b, chan_leaf, lb)))
+        else:
+            residual.append(c)
+
+    for li, filters in enumerate(leaf_filters):
+        if filters:
+            leaf = leaf_nodes[li]
+            leaf_nodes[li] = Filter(leaf.output_names, leaf.output_types,
+                                    leaf, _conjoin(filters))
+
+    est = [estimate_rows(l, catalog) for l in leaf_nodes]
+
+    # greedy: spine = largest; join smallest connected next
+    order = [max(range(len(leaf_nodes)), key=lambda i: est[i])]
+    remaining = set(range(len(leaf_nodes))) - set(order)
+    # key expressions must be channels; all edge endpoint exprs that are
+    # plain InputRefs can be used directly, others appended via projection.
+    while remaining:
+        connected = [
+            i for i in remaining
+            if any((a in order and b == i) or (b in order and a == i)
+                   for (a, b, _, _) in edges)
+        ]
+        pick = min(connected, key=lambda i: est[i]) if connected \
+            else min(remaining, key=lambda i: est[i])
+        order.append(pick)
+        remaining.discard(pick)
+
+    # build the tree left-deep; track mapping (leaf idx, local ch) -> spine ch
+    spine = leaf_nodes[order[0]]
+    pos: dict[tuple[int, int], int] = {
+        (order[0], i): i for i in range(len(spine.output_types))
+    }
+    used_edges = set()
+    for step in range(1, len(order)):
+        li = order[step]
+        right = leaf_nodes[li]
+        lkeys, rkeys = [], []
+        for ei, (a, b, ea, eb) in enumerate(edges):
+            if ei in used_edges:
+                continue
+            if a in order[:step] and b == li:
+                sa, rb = ea, eb
+            elif b in order[:step] and a == li:
+                sa, rb = eb, ea
+                a, b = b, a
+            else:
+                continue
+            used_edges.add(ei)
+            # spine-side expr: remap leaf-local -> spine channels
+            sa_spine = _remap_leaf_to_spine(sa, a, pos)
+            lkeys.append(sa_spine)
+            rkeys.append(rb)
+        lch, spine = _exprs_as_channels(lkeys, spine)
+        rch, right = _exprs_as_channels(rkeys, right)
+        names = tuple(spine.output_names) + tuple(right.output_names)
+        types = tuple(spine.output_types) + tuple(right.output_types)
+        sw = len(spine.output_types)
+        jt = "INNER" if lch else "CROSS"
+        spine = Join(names, types, spine, right, jt, tuple(lch), tuple(rch),
+                     None, distribution=_choose_distribution(right, catalog))
+        for i in range(len(right.output_types)):
+            pos[(li, i)] = sw + i
+
+    # residual conjuncts over the final spine
+    if residual:
+        def remap_residual(e: RowExpression) -> RowExpression:
+            if isinstance(e, InputRef):
+                li, local = chan_leaf[e.index]
+                return InputRef(e.type, pos[(li, local)])
+            if isinstance(e, Call):
+                return Call(e.type, e.name, tuple(remap_residual(a) for a in e.args))
+            return e
+        spine = Filter(spine.output_names, spine.output_types, spine,
+                       _conjoin([remap_residual(r) for r in residual]))
+
+    # overall mapping: original concat channel -> spine channel
+    mapping = []
+    for i in range(total_width):
+        li, local = chan_leaf.get(i, (None, None))
+        mapping.append(pos.get((li, local)) if li is not None else None)
+    return spine, mapping
+
+
+def _remap_leaf_to_spine(e: RowExpression, leaf_idx: int,
+                         pos: dict[tuple[int, int], int]) -> RowExpression:
+    if isinstance(e, InputRef):
+        return InputRef(e.type, pos[(leaf_idx, e.index)])
+    if isinstance(e, Call):
+        return Call(e.type, e.name,
+                    tuple(_remap_leaf_to_spine(a, leaf_idx, pos) for a in e.args))
+    return e
+
+
+def _single_leaf(e: RowExpression, chan_leaf) -> Optional[int]:
+    ls = {chan_leaf[i][0] for i in _refs(e)}
+    return ls.pop() if len(ls) == 1 else None
+
+
+def _remap_to_leaf(e: RowExpression, chan_leaf, li: int) -> RowExpression:
+    if isinstance(e, InputRef):
+        l, local = chan_leaf[e.index]
+        assert l == li
+        return InputRef(e.type, local)
+    if isinstance(e, Call):
+        return Call(e.type, e.name,
+                    tuple(_remap_to_leaf(a, chan_leaf, li) for a in e.args))
+    return e
+
+
+def _exprs_as_channels(exprs: list[RowExpression], node: PlanNode):
+    chans, extra, names = [], [], []
+    for e in exprs:
+        if isinstance(e, InputRef):
+            chans.append(e.index)
+        else:
+            chans.append(len(node.output_types) + len(extra))
+            extra.append(e)
+            names.append(f"_jk{len(node.output_types) + len(extra) - 1}")
+    if extra:
+        base = [InputRef(t, i) for i, t in enumerate(node.output_types)]
+        node = Project(tuple(node.output_names) + tuple(names),
+                       tuple(node.output_types) + tuple(e.type for e in extra),
+                       node, tuple(base + extra))
+    return chans, node
+
+
+def _split_and(e: RowExpression) -> list[RowExpression]:
+    if isinstance(e, Call) and e.name == "$and":
+        out = []
+        for a in e.args:
+            out.extend(_split_and(a))
+        return out
+    return [e]
+
+
+def _conjoin(terms: list[RowExpression]) -> RowExpression:
+    if len(terms) == 1:
+        return terms[0]
+    return Call(BOOLEAN, "$and", tuple(terms))
+
+
+# --------------------------------------------------------------------------
+# column pruning
+
+
+def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[int]]]:
+    """Drop unused output channels bottom-up.  Returns (node, mapping
+    old-channel -> new-channel or None if dropped)."""
+
+    def key_mapping(kept: list[int], width: int) -> list[Optional[int]]:
+        m: list[Optional[int]] = [None] * width
+        for new, old in enumerate(kept):
+            m[old] = new
+        return m
+
+    if isinstance(node, Output):
+        child, m = _prune(node.source, set(range(len(node.source.output_types))))
+        assert all(x is not None for x in m)
+        return replace(node, source=child), list(range(len(node.output_types)))
+
+    if isinstance(node, Project):
+        kept = sorted(needed)
+        child_needed = set()
+        for i in kept:
+            child_needed |= _refs(node.expressions[i])
+        child, cm = _prune(node.source, child_needed)
+        exprs = tuple(_remap_expr(node.expressions[i], cm) for i in kept)
+        out = Project(tuple(node.output_names[i] for i in kept),
+                      tuple(node.output_types[i] for i in kept), child, exprs)
+        return out, key_mapping(kept, len(node.output_types))
+
+    if isinstance(node, Filter):
+        child_needed = set(needed) | _refs(node.predicate)
+        child, cm = _prune(node.source, child_needed)
+        pred = _remap_expr(node.predicate, cm)
+        out = Filter(child.output_names, child.output_types, child, pred)
+        return out, cm
+
+    if isinstance(node, TableScan):
+        kept = sorted(needed)
+        if not kept:
+            kept = [0]  # keep one channel for row counting
+        out = TableScan(tuple(node.output_names[i] for i in kept),
+                        tuple(node.output_types[i] for i in kept),
+                        node.catalog, node.table,
+                        tuple(node.columns[i] for i in kept))
+        return out, key_mapping(kept, len(node.output_types))
+
+    if isinstance(node, Values):
+        return node, list(range(len(node.output_types)))
+
+    if isinstance(node, Aggregate):
+        nk = len(node.group_keys)
+        kept_aggs = [i for i in range(len(node.aggregates))
+                     if (nk + i) in needed]
+        child_needed = set(node.group_keys)
+        for i in kept_aggs:
+            if node.aggregates[i].arg >= 0:
+                child_needed.add(node.aggregates[i].arg)
+        child, cm = _prune(node.source, child_needed)
+        aggs = tuple(
+            replace(node.aggregates[i],
+                    arg=cm[node.aggregates[i].arg] if node.aggregates[i].arg >= 0 else -1)
+            for i in kept_aggs)
+        keys = tuple(cm[k] for k in node.group_keys)
+        kept = list(range(nk)) + [nk + i for i in kept_aggs]
+        out = Aggregate(tuple(node.output_names[i] for i in kept),
+                        tuple(node.output_types[i] for i in kept),
+                        child, keys, aggs, node.step)
+        return out, key_mapping(kept, len(node.output_types))
+
+    if isinstance(node, Join):
+        lw = len(node.left.output_types)
+        left_needed = {i for i in needed if i < lw} | set(node.left_keys)
+        right_needed = {i - lw for i in needed if i >= lw} | set(node.right_keys)
+        if node.residual is not None:
+            for r in _refs(node.residual):
+                (left_needed if r < lw else right_needed).add(r if r < lw else r - lw)
+        left, lm = _prune(node.left, left_needed)
+        right, rm = _prune(node.right, right_needed)
+        lw_new = len(left.output_types)
+        mapping: list[Optional[int]] = []
+        for i in range(lw):
+            mapping.append(lm[i])
+        for i in range(len(node.right.output_types)):
+            mapping.append(rm[i] + lw_new if rm[i] is not None else None)
+        residual = (_remap_expr(node.residual, mapping)
+                    if node.residual is not None else None)
+        names = tuple(left.output_names) + tuple(right.output_names)
+        types = tuple(left.output_types) + tuple(right.output_types)
+        out = replace(node, output_names=names, output_types=types,
+                      left=left, right=right,
+                      left_keys=tuple(lm[k] for k in node.left_keys),
+                      right_keys=tuple(rm[k] for k in node.right_keys),
+                      residual=residual)
+        return out, mapping
+
+    if isinstance(node, SemiJoin):
+        sw = len(node.source.output_types)
+        src_needed = {i for i in needed if i < sw} | set(node.source_keys)
+        filt_needed = set(node.filter_keys)
+        if node.residual is not None:
+            for r in _refs(node.residual):
+                (src_needed if r < sw else filt_needed).add(r if r < sw else r - sw)
+        src, sm = _prune(node.source, src_needed)
+        filt, fm = _prune(node.filter_source, filt_needed)
+        sw_new = len(src.output_types)
+        mapping = [sm[i] for i in range(sw)] + [sw_new]
+        residual = None
+        if node.residual is not None:
+            # residual layout: source channels ++ filter-source channels
+            full = [sm[i] for i in range(sw)] + \
+                   [fm[i] + sw_new if fm[i] is not None else None
+                    for i in range(len(node.filter_source.output_types))]
+            residual = _remap_expr(node.residual, full)
+        names = tuple(src.output_names) + (node.output_names[-1],)
+        types = tuple(src.output_types) + (BOOLEAN,)
+        out = replace(node, output_names=names, output_types=types,
+                      source=src, filter_source=filt,
+                      source_keys=tuple(sm[k] for k in node.source_keys),
+                      filter_keys=tuple(fm[k] for k in node.filter_keys),
+                      residual=residual)
+        return out, mapping
+
+    if isinstance(node, (Sort, TopN)):
+        child_needed = set(needed) | {k.channel for k in node.keys}
+        child, cm = _prune(node.source, child_needed)
+        keys = tuple(replace(k, channel=cm[k.channel]) for k in node.keys)
+        out = replace(node, source=child, keys=keys,
+                      output_names=child.output_names,
+                      output_types=child.output_types)
+        return out, cm
+
+    if isinstance(node, (Limit, Exchange, TableWriter)):
+        if isinstance(node, TableWriter):
+            needed = set(range(len(node.source.output_types)))
+        child, cm = _prune(node.source, needed if not isinstance(node, TableWriter)
+                           else set(range(len(node.source.output_types))))
+        kwargs = dict(source=child)
+        if not isinstance(node, TableWriter):
+            kwargs["output_names"] = child.output_names
+            kwargs["output_types"] = child.output_types
+        if isinstance(node, Exchange):
+            kwargs["partition_keys"] = tuple(cm[k] for k in node.partition_keys)
+        return replace(node, **kwargs), cm if not isinstance(node, TableWriter) \
+            else list(range(len(node.output_types)))
+
+    raise NotImplementedError(f"prune: {type(node).__name__}")

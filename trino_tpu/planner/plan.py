@@ -1,0 +1,272 @@
+"""Logical plan nodes.
+
+The subset of Trino's 66 node types (reference: sql/planner/plan/*.java —
+TableScanNode, FilterNode, ProjectNode, AggregationNode, JoinNode,
+SemiJoinNode, TopNNode, SortNode, LimitNode, ValuesNode, ExchangeNode,
+OutputNode, TableWriterNode) the engine currently executes.  Every node's
+output is a flat list of (name, type) channels; expressions inside a node
+reference its input channels by index (InputRef), so plans need no symbol
+table — the channel layout IS the contract (Trino uses named Symbols +
+a SymbolAllocator; indices are the array-first equivalent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..spi.types import BIGINT, BOOLEAN, DOUBLE, Type
+from ..sql.ir import RowExpression
+
+__all__ = [
+    "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
+    "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
+    "Output", "Exchange", "TableWriter", "DistinctLimit", "plan_text",
+]
+
+
+@dataclass(frozen=True)
+class PlanNode:
+    output_names: tuple[str, ...]
+    output_types: tuple[Type, ...]
+
+    @property
+    def children(self) -> tuple["PlanNode", ...]:
+        return ()
+
+    def label(self) -> str:
+        return type(self).__name__
+
+
+@dataclass(frozen=True)
+class TableScan(PlanNode):
+    catalog: str = ""
+    table: str = ""
+    columns: tuple[str, ...] = ()  # connector column names, 1:1 with outputs
+
+    def label(self) -> str:
+        return f"TableScan[{self.catalog}.{self.table} {list(self.columns)}]"
+
+
+@dataclass(frozen=True)
+class Filter(PlanNode):
+    source: PlanNode = None
+    predicate: RowExpression = None
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"Filter[{self.predicate}]"
+
+
+@dataclass(frozen=True)
+class Project(PlanNode):
+    source: PlanNode = None
+    expressions: tuple[RowExpression, ...] = ()
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"Project[{', '.join(f'{n}:={e}' for n, e in zip(self.output_names, self.expressions))}]"
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """One aggregate: fn in (count, sum, avg, min, max, count_star, any_value);
+    arg is an input channel index (or -1 for count(*))."""
+
+    fn: str
+    arg: int
+    type: Type
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Aggregate(PlanNode):
+    source: PlanNode = None
+    group_keys: tuple[int, ...] = ()  # input channel indices
+    aggregates: tuple[AggCall, ...] = ()
+    # SINGLE for now; PARTIAL/FINAL appear when the fragmenter splits
+    step: str = "SINGLE"
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        aggs = ", ".join(f"{a.fn}({'*' if a.arg < 0 else '#%d' % a.arg}{' distinct' if a.distinct else ''})"
+                         for a in self.aggregates)
+        return f"Aggregate[{self.step} keys={list(self.group_keys)} {aggs}]"
+
+
+@dataclass(frozen=True)
+class Join(PlanNode):
+    """Equi-join with optional residual filter.  Output channels are
+    left-columns ++ right-columns (probe side = left)."""
+
+    left: PlanNode = None
+    right: PlanNode = None
+    join_type: str = "INNER"  # INNER | LEFT
+    left_keys: tuple[int, ...] = ()
+    right_keys: tuple[int, ...] = ()
+    residual: Optional[RowExpression] = None  # over concatenated layout
+    # execution strategy hint (optimizer): PARTITIONED | BROADCAST
+    distribution: str = "BROADCAST"
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def label(self) -> str:
+        keys = ", ".join(f"#{l}=#{r}" for l, r in zip(self.left_keys, self.right_keys))
+        res = f" residual={self.residual}" if self.residual else ""
+        return f"Join[{self.join_type} {self.distribution} {keys}{res}]"
+
+
+@dataclass(frozen=True)
+class SemiJoin(PlanNode):
+    """EXISTS/IN: keeps (semi) or drops (anti) source rows with a match in
+    filter_source.  Output = source channels unchanged."""
+
+    source: PlanNode = None
+    filter_source: PlanNode = None
+    source_keys: tuple[int, ...] = ()
+    filter_keys: tuple[int, ...] = ()
+    negated: bool = False  # anti join
+    # residual over source-channels ++ filter-source-channels, evaluated
+    # per candidate pair (correlated EXISTS with non-equi conjuncts, Q21)
+    residual: Optional[RowExpression] = None
+    null_aware: bool = False  # NOT IN NULL semantics
+
+    @property
+    def children(self):
+        return (self.source, self.filter_source)
+
+    def label(self) -> str:
+        keys = ", ".join(f"#{l}~#{r}" for l, r in zip(self.source_keys, self.filter_keys))
+        return f"{'Anti' if self.negated else 'Semi'}Join[{keys}{' residual=' + str(self.residual) if self.residual else ''}]"
+
+
+@dataclass(frozen=True)
+class SortKey:
+    channel: int
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+@dataclass(frozen=True)
+class Sort(PlanNode):
+    source: PlanNode = None
+    keys: tuple[SortKey, ...] = ()
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return "Sort[%s]" % ", ".join(
+            f"#{k.channel}{'' if k.ascending else ' desc'}" for k in self.keys)
+
+
+@dataclass(frozen=True)
+class TopN(PlanNode):
+    source: PlanNode = None
+    count: int = 0
+    keys: tuple[SortKey, ...] = ()
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"TopN[{self.count}; %s]" % ", ".join(
+            f"#{k.channel}{'' if k.ascending else ' desc'}" for k in self.keys)
+
+
+@dataclass(frozen=True)
+class Limit(PlanNode):
+    source: PlanNode = None
+    count: int = 0
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"Limit[{self.count}]"
+
+
+@dataclass(frozen=True)
+class DistinctLimit(PlanNode):
+    source: PlanNode = None
+    count: Optional[int] = None  # None = plain DISTINCT
+
+    @property
+    def children(self):
+        return (self.source,)
+
+
+@dataclass(frozen=True)
+class Values(PlanNode):
+    rows: tuple[tuple, ...] = ()
+
+    def label(self) -> str:
+        return f"Values[{len(self.rows)} rows]"
+
+
+@dataclass(frozen=True)
+class Output(PlanNode):
+    source: PlanNode = None
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"Output[{', '.join(self.output_names)}]"
+
+
+@dataclass(frozen=True)
+class Exchange(PlanNode):
+    """Data redistribution boundary.  scope=REMOTE splits fragments
+    (AddExchanges.java:138); scope=LOCAL repartitions between in-task
+    pipelines (AddLocalExchanges.java:111)."""
+
+    source: PlanNode = None
+    kind: str = "GATHER"  # GATHER | REPARTITION | BROADCAST
+    scope: str = "REMOTE"  # REMOTE | LOCAL
+    partition_keys: tuple[int, ...] = ()
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        keys = f" keys={list(self.partition_keys)}" if self.partition_keys else ""
+        return f"Exchange[{self.scope} {self.kind}{keys}]"
+
+
+@dataclass(frozen=True)
+class TableWriter(PlanNode):
+    source: PlanNode = None
+    catalog: str = ""
+    table: str = ""
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"TableWriter[{self.catalog}.{self.table}]"
+
+
+def plan_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style tree rendering."""
+    lines = ["  " * indent + "- " + node.label()]
+    for c in node.children:
+        lines.append(plan_text(c, indent + 1))
+    return "\n".join(lines)

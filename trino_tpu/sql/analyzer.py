@@ -1,0 +1,458 @@
+"""Name resolution, type checking, AST-expression -> IR translation.
+
+Plays the role of sql/analyzer/StatementAnalyzer + ExpressionAnalyzer and the
+IR translation half of sql/planner/QueryPlanner (reference:
+sql/analyzer/ExpressionAnalyzer.java, sql/relational/SqlToRowExpressionTranslator
+pattern).  Scopes are flat channel lists with an optional parent (correlated
+references become OuterRef, eliminated later by decorrelation).
+
+Type rules (intentional, documented divergences from Trino):
+- integer literals and integral columns type as BIGINT throughout;
+- decimal +,-,* follow Trino scale rules (capped at precision 18);
+  decimal division and AVG produce DOUBLE (Trino keeps decimal — we trade
+  that for exactness-free simplicity and match the float oracle);
+- VARCHAR carries no length.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ..spi.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    TIMESTAMP,
+    UNKNOWN,
+    VARCHAR,
+    DecimalType,
+    Type,
+    common_super_type,
+    is_numeric,
+    is_string,
+    parse_type,
+)
+from . import ast
+from .ir import Call, InputRef, Literal, OuterRef, RowExpression
+
+__all__ = [
+    "Field", "Scope", "Translator", "AggregateCollector", "AnalysisError",
+    "AGG_FUNCTIONS", "cast_to", "rewrite_expr", "split_conjuncts",
+    "agg_result_type",
+]
+
+
+class AnalysisError(ValueError):
+    pass
+
+
+AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "any_value"}
+
+_SCALAR_TYPES: dict[str, str] = {
+    # name -> rule tag used below
+    "abs": "arg", "negate": "arg", "round": "arg",
+    "sqrt": "double", "exp": "double", "ln": "double", "log10": "double",
+    "power": "double", "pow": "double",
+    "floor": "arg", "ceiling": "arg", "ceil": "arg",
+    "year": "bigint", "month": "bigint", "day": "bigint", "quarter": "bigint",
+    "length": "bigint",
+    "substring": "varchar", "substr": "varchar", "upper": "varchar",
+    "lower": "varchar", "trim": "varchar", "ltrim": "varchar", "rtrim": "varchar",
+}
+
+
+@dataclass(frozen=True)
+class Field:
+    name: Optional[str]
+    type: Type
+    qualifier: Optional[str] = None  # relation alias / table name
+
+
+class Scope:
+    def __init__(self, fields: Sequence[Field], parent: Optional["Scope"] = None):
+        self.fields = list(fields)
+        self.parent = parent
+
+    def resolve(self, parts: tuple[str, ...]) -> tuple[int, int, Field]:
+        """-> (level, channel, field); level 0 = this scope."""
+        level = 0
+        scope: Optional[Scope] = self
+        while scope is not None:
+            hits = scope._match(parts)
+            if len(hits) == 1:
+                i = hits[0]
+                return level, i, scope.fields[i]
+            if len(hits) > 1:
+                raise AnalysisError(f"column reference is ambiguous: {'.'.join(parts)}")
+            scope = scope.parent
+            level += 1
+        raise AnalysisError(f"column cannot be resolved: {'.'.join(parts)}")
+
+    def _match(self, parts: tuple[str, ...]) -> list[int]:
+        if len(parts) == 1:
+            return [i for i, f in enumerate(self.fields) if f.name == parts[0]]
+        if len(parts) >= 2:
+            q, n = parts[-2], parts[-1]
+            return [
+                i for i, f in enumerate(self.fields)
+                if f.name == n and f.qualifier is not None and f.qualifier == q
+            ]
+        return []
+
+
+class AggregateCollector:
+    """Dedups aggregate calls; translation returns $aggref placeholders that
+    the planner rewrites to post-aggregation channels."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, Optional[RowExpression], bool, Type]] = []
+
+    def add(self, fn: str, arg: Optional[RowExpression], distinct: bool, type_: Type) -> int:
+        key = (fn, arg, distinct)
+        for i, (f, a, d, _) in enumerate(self.calls):
+            if (f, a, d) == key:
+                return i
+        self.calls.append((fn, arg, distinct, type_))
+        return len(self.calls) - 1
+
+
+def agg_result_type(fn: str, arg_type: Optional[Type]) -> Type:
+    if fn == "count":
+        return BIGINT
+    if fn == "avg":
+        return DOUBLE
+    if fn == "sum":
+        if isinstance(arg_type, DecimalType):
+            return DecimalType(18, arg_type.scale)
+        if arg_type in (DOUBLE,) or (arg_type and arg_type.name == "real"):
+            return DOUBLE
+        return BIGINT
+    return arg_type  # min/max/any_value
+
+
+def cast_to(e: RowExpression, t: Type) -> RowExpression:
+    if e.type == t:
+        return e
+    if isinstance(e, Literal) and e.value is None:
+        return Literal(t, None)
+    return Call(t, "$cast", (e,))
+
+
+def _decimal_of(t: Type) -> DecimalType:
+    if isinstance(t, DecimalType):
+        return t
+    return DecimalType(18, 0)
+
+
+def split_conjuncts(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.LogicalOp) and e.op == "AND":
+        out: list[ast.Expr] = []
+        for t in e.terms:
+            out.extend(split_conjuncts(t))
+        return out
+    return [e]
+
+
+def rewrite_expr(e: RowExpression, mapping: dict[RowExpression, RowExpression]) -> RowExpression:
+    """Structural bottom-up rewrite (used to map group-by expressions and
+    $aggref placeholders onto post-aggregation channels)."""
+    if e in mapping:
+        return mapping[e]
+    if isinstance(e, Call):
+        new_args = tuple(rewrite_expr(a, mapping) for a in e.args)
+        if new_args != e.args:
+            new = Call(e.type, e.name, new_args)
+            return mapping.get(new, new)
+    return e
+
+
+class Translator:
+    """AST expression -> typed IR over a scope.
+
+    ``subquery_cb(node) -> RowExpression`` lets the planner splice subquery
+    results in (joins appended to the current relation); ``aggregates`` makes
+    aggregate calls legal, emitting ``$aggref`` placeholder calls.
+    """
+
+    def __init__(
+        self,
+        scope: Scope,
+        aggregates: Optional[AggregateCollector] = None,
+        subquery_cb: Optional[Callable[[ast.Expr], RowExpression]] = None,
+    ):
+        self.scope = scope
+        self.aggregates = aggregates
+        self.subquery_cb = subquery_cb
+
+    # -- entry -------------------------------------------------------------
+    def translate(self, e: ast.Expr) -> RowExpression:
+        m = getattr(self, f"_t_{type(e).__name__}", None)
+        if m is None:
+            raise AnalysisError(f"unsupported expression: {type(e).__name__}")
+        return m(e)
+
+    # -- leaves ------------------------------------------------------------
+    def _t_ColumnRef(self, e: ast.ColumnRef) -> RowExpression:
+        level, idx, field = self.scope.resolve(e.parts)
+        if level == 0:
+            return InputRef(field.type, idx)
+        return OuterRef(field.type, idx, level)
+
+    def _t_IntLiteral(self, e):
+        return Literal(BIGINT, e.value)
+
+    def _t_DecimalLiteral(self, e):
+        text = e.text.lstrip("-")
+        scale = len(text.split(".")[1]) if "." in text else 0
+        return Literal(DecimalType(18, scale), e.text)
+
+    def _t_DoubleLiteral(self, e):
+        return Literal(DOUBLE, e.value)
+
+    def _t_StringLiteral(self, e):
+        return Literal(VARCHAR, e.value)
+
+    def _t_BooleanLiteral(self, e):
+        return Literal(BOOLEAN, e.value)
+
+    def _t_NullLiteral(self, e):
+        return Literal(UNKNOWN, None)
+
+    def _t_DateLiteral(self, e):
+        return Literal(DATE, e.text)
+
+    def _t_TimestampLiteral(self, e):
+        return Literal(TIMESTAMP, e.text)
+
+    def _t_IntervalLiteral(self, e):
+        raise AnalysisError("interval literal only valid in date arithmetic")
+
+    # -- arithmetic --------------------------------------------------------
+    _OPNAMES = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+                "%": "modulus"}
+
+    def _t_BinaryOp(self, e: ast.BinaryOp) -> RowExpression:
+        if e.op == "||":
+            raise AnalysisError("|| concat not yet supported")
+        # date +- interval
+        if isinstance(e.right, ast.IntervalLiteral):
+            left = self.translate(e.left)
+            if left.type not in (DATE, TIMESTAMP):
+                raise AnalysisError("interval arithmetic requires a date")
+            n = int(e.right.value)
+            if e.right.negative:
+                n = -n
+            if e.op == "-":
+                n = -n
+            unit = e.right.unit
+            if unit == "DAY":
+                return Call(left.type, "add" if n >= 0 else "subtract",
+                            (left, Literal(BIGINT, abs(n))))
+            months = n * (12 if unit == "YEAR" else 1)
+            if unit not in ("YEAR", "MONTH"):
+                raise AnalysisError(f"unsupported interval unit {unit}")
+            return Call(left.type, "add_months", (left, Literal(BIGINT, months)))
+        left = self.translate(e.left)
+        right = self.translate(e.right)
+        name = self._OPNAMES[e.op]
+        lt, rt = left.type, right.type
+        if lt == DATE and rt == DATE and name == "subtract":
+            return Call(BIGINT, "subtract",
+                        (cast_to(left, BIGINT), cast_to(right, BIGINT)))
+        if not (is_numeric(lt) or lt == DATE) or not (is_numeric(rt) or rt == DATE):
+            raise AnalysisError(f"cannot apply {e.op} to {lt}, {rt}")
+        if lt == DATE or rt == DATE:  # date + days
+            return Call(DATE, name, (left, right))
+        if DOUBLE in (lt, rt) or lt.name == "real" or rt.name == "real":
+            return Call(DOUBLE, name, (cast_to(left, DOUBLE), cast_to(right, DOUBLE)))
+        if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
+            if name == "divide":
+                return Call(DOUBLE, name, (cast_to(left, DOUBLE), cast_to(right, DOUBLE)))
+            ld, rd = _decimal_of(lt), _decimal_of(rt)
+            if name in ("add", "subtract"):
+                out = DecimalType(18, max(ld.scale, rd.scale))
+            elif name == "multiply":
+                out = DecimalType(18, ld.scale + rd.scale)
+            else:  # modulus
+                out = DecimalType(18, max(ld.scale, rd.scale))
+            return Call(out, name, (cast_to(left, ld) if not isinstance(lt, DecimalType) else left,
+                                    cast_to(right, rd) if not isinstance(rt, DecimalType) else right))
+        return Call(BIGINT, name, (cast_to(left, BIGINT), cast_to(right, BIGINT)))
+
+    def _t_UnaryOp(self, e: ast.UnaryOp) -> RowExpression:
+        operand = self.translate(e.operand)
+        if e.op == "-":
+            return Call(operand.type, "negate", (operand,))
+        return operand
+
+    # -- predicates --------------------------------------------------------
+    def _promote_pair(self, left: RowExpression, right: RowExpression):
+        lt, rt = left.type, right.type
+        if lt == rt:
+            return left, right
+        common = common_super_type(lt, rt)
+        if common is None:
+            raise AnalysisError(f"cannot compare {lt} and {rt}")
+        return cast_to(left, common), cast_to(right, common)
+
+    _CMPNAMES = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+
+    def _t_Comparison(self, e: ast.Comparison) -> RowExpression:
+        if isinstance(e.right, (ast.ScalarSubquery,)) or isinstance(e.left, ast.ScalarSubquery):
+            if self.subquery_cb is None:
+                raise AnalysisError("subquery not allowed here")
+            left = (self.subquery_cb(e.left) if isinstance(e.left, ast.ScalarSubquery)
+                    else self.translate(e.left))
+            right = (self.subquery_cb(e.right) if isinstance(e.right, ast.ScalarSubquery)
+                     else self.translate(e.right))
+        else:
+            left = self.translate(e.left)
+            right = self.translate(e.right)
+        left, right = self._promote_pair(left, right)
+        return Call(BOOLEAN, self._CMPNAMES[e.op], (left, right))
+
+    def _t_LogicalOp(self, e: ast.LogicalOp) -> RowExpression:
+        terms = tuple(cast_to(self.translate(t), BOOLEAN) for t in e.terms)
+        return Call(BOOLEAN, "$and" if e.op == "AND" else "$or", terms)
+
+    def _t_Not(self, e: ast.Not) -> RowExpression:
+        return Call(BOOLEAN, "$not", (cast_to(self.translate(e.operand), BOOLEAN),))
+
+    def _t_IsNull(self, e: ast.IsNull) -> RowExpression:
+        inner = Call(BOOLEAN, "$is_null", (self.translate(e.operand),))
+        return Call(BOOLEAN, "$not", (inner,)) if e.negated else inner
+
+    def _t_Between(self, e: ast.Between) -> RowExpression:
+        operand = self.translate(e.operand)
+        low = self.translate(e.low)
+        high = self.translate(e.high)
+        a, lo = self._promote_pair(operand, low)
+        b, hi = self._promote_pair(operand, high)
+        out = Call(BOOLEAN, "$and", (
+            Call(BOOLEAN, "ge", (a, lo)),
+            Call(BOOLEAN, "le", (b, hi)),
+        ))
+        return Call(BOOLEAN, "$not", (out,)) if e.negated else out
+
+    def _t_InList(self, e: ast.InList) -> RowExpression:
+        operand = self.translate(e.operand)
+        items = [self.translate(i) for i in e.items]
+        if is_string(operand.type):
+            cast_items = items
+        else:
+            common = operand.type
+            for i in items:
+                c = common_super_type(common, i.type)
+                if c is None:
+                    raise AnalysisError(f"IN list type mismatch: {common} vs {i.type}")
+                common = c
+            operand = cast_to(operand, common)
+            cast_items = [cast_to(i, common) for i in items]
+        out = Call(BOOLEAN, "$in", (operand, *cast_items))
+        return Call(BOOLEAN, "$not", (out,)) if e.negated else out
+
+    def _t_Like(self, e: ast.Like) -> RowExpression:
+        args = [self.translate(e.operand), self.translate(e.pattern)]
+        if e.escape is not None:
+            args.append(self.translate(e.escape))
+        out = Call(BOOLEAN, "$like", tuple(args))
+        return Call(BOOLEAN, "$not", (out,)) if e.negated else out
+
+    def _t_InSubquery(self, e: ast.InSubquery) -> RowExpression:
+        if self.subquery_cb is None:
+            raise AnalysisError("IN subquery not allowed here")
+        return self.subquery_cb(e)
+
+    def _t_Exists(self, e: ast.Exists) -> RowExpression:
+        if self.subquery_cb is None:
+            raise AnalysisError("EXISTS not allowed here")
+        return self.subquery_cb(e)
+
+    def _t_ScalarSubquery(self, e: ast.ScalarSubquery) -> RowExpression:
+        if self.subquery_cb is None:
+            raise AnalysisError("scalar subquery not allowed here")
+        return self.subquery_cb(e)
+
+    # -- conditionals ------------------------------------------------------
+    def _t_Case(self, e: ast.Case) -> RowExpression:
+        # result type = common super of branches
+        results = [self.translate(w.result) for w in e.whens]
+        default = self.translate(e.default) if e.default is not None else Literal(UNKNOWN, None)
+        out_t = default.type
+        for r in results:
+            c = common_super_type(out_t, r.type)
+            if c is None:
+                raise AnalysisError(f"CASE branch types differ: {out_t} vs {r.type}")
+            out_t = c
+        if out_t == UNKNOWN:
+            raise AnalysisError("cannot determine CASE type")
+        results = [cast_to(r, out_t) for r in results]
+        default = cast_to(default, out_t)
+        expr = default
+        operand = self.translate(e.operand) if e.operand is not None else None
+        for w, r in zip(reversed(e.whens), reversed(results)):
+            if operand is not None:
+                cmp_l, cmp_r = self._promote_pair(operand, self.translate(w.condition))
+                cond = Call(BOOLEAN, "eq", (cmp_l, cmp_r))
+            else:
+                cond = cast_to(self.translate(w.condition), BOOLEAN)
+            expr = Call(out_t, "$if", (cond, r, expr))
+        return expr
+
+    def _t_Cast(self, e: ast.Cast) -> RowExpression:
+        inner = self.translate(e.operand)
+        return cast_to(inner, parse_type(e.type_name))
+
+    def _t_Extract(self, e: ast.Extract) -> RowExpression:
+        inner = self.translate(e.operand)
+        fn = e.field_.lower()
+        if fn not in ("year", "month", "day", "quarter"):
+            raise AnalysisError(f"EXTRACT({e.field_}) not supported")
+        return Call(BIGINT, fn, (inner,))
+
+    # -- function calls ----------------------------------------------------
+    def _t_FunctionCall(self, e: ast.FunctionCall) -> RowExpression:
+        name = e.name.lower()
+        if name in AGG_FUNCTIONS or (name == "count" and e.is_star):
+            if self.aggregates is None:
+                raise AnalysisError(f"aggregate {name} not allowed here")
+            if e.is_star or not e.args:
+                if name != "count":
+                    raise AnalysisError(f"{name} requires an argument")
+                idx = self.aggregates.add("count", None, False, BIGINT)
+                return Call(BIGINT, "$aggref", (Literal(BIGINT, idx),))
+            arg = self.translate(e.args[0])
+            out_t = agg_result_type(name, arg.type)
+            idx = self.aggregates.add(name, arg, e.distinct, out_t)
+            return Call(out_t, "$aggref", (Literal(BIGINT, idx),))
+        if name == "coalesce":
+            args = [self.translate(a) for a in e.args]
+            out_t = UNKNOWN
+            for a in args:
+                c = common_super_type(out_t, a.type)
+                if c is None:
+                    raise AnalysisError("COALESCE argument types differ")
+                out_t = c
+            return Call(out_t, "$coalesce", tuple(cast_to(a, out_t) for a in args))
+        if name == "nullif":
+            a = self.translate(e.args[0])
+            b = self.translate(e.args[1])
+            pa, pb = self._promote_pair(a, b)
+            return Call(a.type, "$if",
+                        (Call(BOOLEAN, "eq", (pa, pb)), Literal(a.type, None), a))
+        if name not in _SCALAR_TYPES:
+            raise AnalysisError(f"function not registered: {name}")
+        args = tuple(self.translate(a) for a in e.args)
+        rule = _SCALAR_TYPES[name]
+        if rule == "arg":
+            out_t = args[0].type
+        elif rule == "double":
+            out_t = DOUBLE
+            args = tuple(cast_to(a, DOUBLE) for a in args)
+        elif rule == "bigint":
+            out_t = BIGINT
+        else:
+            out_t = VARCHAR
+        return Call(out_t, name, args)

@@ -57,6 +57,22 @@ class Call(RowExpression):
         return f"{self.name}({', '.join(map(str, self.args))})"
 
 
+@dataclass(frozen=True)
+class OuterRef(RowExpression):
+    """Reference to field ``index`` of an enclosing query's scope, ``level``
+    scopes up.  Only appears transiently while planning subqueries; the
+    decorrelation rewrites (planner/logical.py) eliminate every OuterRef
+    before execution — mirrors Trino's ApplyNode + correlation symbols
+    (reference: sql/planner/plan/ApplyNode.java, optimizer rules
+    TransformCorrelated*.java)."""
+
+    index: int = 0
+    level: int = 1
+
+    def __str__(self) -> str:
+        return f"outer{self.level}#{self.index}"
+
+
 def call(name: str, type_: Type, *args: RowExpression) -> Call:
     return Call(type_, name, tuple(args))
 

@@ -1,0 +1,61 @@
+"""Benchmark: fused TPC-H Q1 kernel throughput on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = device rows/sec over a single-thread numpy CPU implementation
+of the same query measured in the same process (the reference publishes no
+absolute numbers — BASELINE.json.published = {} — so the baseline is
+self-measured, per SURVEY §6).
+
+Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from trino_tpu.bench_kernels import Q1Batch, make_q1_inputs, q1_numpy, q1_step
+
+    host = make_q1_inputs(sf)
+    n = int(host.shipdate.shape[0])
+
+    dev = Q1Batch(*[jax.device_put(jnp.asarray(c)) for c in host])
+    # warmup / compile
+    out = q1_step(dev)
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = q1_step(dev)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    rows_per_sec = n / dt
+
+    t0 = time.perf_counter()
+    q1_numpy(host)
+    cpu_dt = time.perf_counter() - t0
+    cpu_rows_per_sec = n / cpu_dt
+
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Regression tests for review findings: scalar-subquery semantics, string
+join dictionaries, null-aware NOT IN, distinct-agg NULL collisions, GROUP BY
+validation, oracle transpile precedence."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.exec import kernels as K
+from trino_tpu.exec.operators import JoinBridge, JoinBuildSink, SemiJoinOperator
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.spi import BIGINT, BOOLEAN, VARCHAR, Column, ColumnBatch
+from trino_tpu.sql.analyzer import AnalysisError
+from trino_tpu.testing.oracle import transpile
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return StandaloneQueryRunner()
+
+
+def test_correlated_count_subquery_returns_zero(runner):
+    # every order matches zero lineitems under quantity < 0: count must be
+    # 0 (not NULL), so the equality keeps all rows
+    rows = runner.execute(
+        "select count(*) from orders o where 0 = "
+        "(select count(*) from lineitem l "
+        " where l.l_orderkey = o.o_orderkey and l.l_quantity < 0)"
+    ).rows()
+    assert rows == [(15000,)]
+
+
+def test_uncorrelated_empty_scalar_subquery_yields_null(runner):
+    # empty scalar subquery -> NULL (not zero rows): IS NULL keeps all 25
+    rows = runner.execute(
+        "select count(*) from nation where "
+        "(select r_regionkey from region where r_name = 'NOPE') is null"
+    ).rows()
+    assert rows == [(25,)]
+
+
+def test_multirow_scalar_subquery_raises(runner):
+    with pytest.raises(RuntimeError, match="multiple rows"):
+        runner.execute(
+            "select count(*) from nation where n_regionkey = "
+            "(select r_regionkey from region)")
+
+
+def test_string_join_across_dictionaries(runner):
+    runner.execute("create table memory.nat_names as select n_name from nation "
+                   "where n_regionkey = 2")
+    rows = runner.execute(
+        "select count(*) from nation a, memory.nat_names b "
+        "where a.n_name = b.n_name").rows()
+    assert rows == [(5,)]
+
+
+def test_group_by_validation(runner):
+    with pytest.raises(AnalysisError, match="GROUP BY"):
+        runner.execute(
+            "select o_custkey, count(*) from orders group by o_orderkey")
+
+
+def _mark_of(source_batch, build_batch, build_keys, source_keys, null_aware):
+    bridge = JoinBridge()
+    sink = JoinBuildSink(bridge, build_keys, build_batch.types, build_batch.names)
+    sink.add_input(build_batch)
+    sink.finish_input()
+    op = SemiJoinOperator(bridge, source_keys, null_aware, None,
+                          list(source_batch.names) + ["mark"],
+                          list(source_batch.types) + [BOOLEAN])
+    op.add_input(source_batch)
+    out = op.get_output()
+    mark = out.columns[-1]
+    return mark.to_pylist()
+
+
+def test_not_in_empty_set_with_null_probe():
+    probe = ColumnBatch(["x"], [Column.from_values(BIGINT, [1, None, 3])])
+    build = ColumnBatch(["y"], [Column.from_values(BIGINT, [])])
+    # x IN (empty) is FALSE for every row, even NULL x
+    assert _mark_of(probe, build, [0], [0], null_aware=True) == [False, False, False]
+
+
+def test_not_in_with_build_null():
+    probe = ColumnBatch(["x"], [Column.from_values(BIGINT, [1, 2, None])])
+    build = ColumnBatch(["y"], [Column.from_values(BIGINT, [1, None])])
+    # 1 IN (1, NULL) -> TRUE; 2 IN (1, NULL) -> UNKNOWN; NULL IN ... -> UNKNOWN
+    assert _mark_of(probe, build, [0], [0], null_aware=True) == [True, None, None]
+
+
+def test_distinct_count_null_storage_collision():
+    # group has a NULL (storage fill 0) AND a genuine value 0: count(distinct)
+    # must count the real 0 and ignore the NULL
+    data = np.array([0, 0, 5], dtype=np.int64)
+    valid = np.array([False, True, True])
+    gidk = np.zeros(3, dtype=np.int64)
+    perm, gid, n = K.group_ids([(gidk, None)])
+    (res,) = K.grouped_reduce(perm, gid, n,
+                              [("count", data, valid, np.int64, True)])
+    assert list(res[0]) == [2]  # distinct {0, 5}
+
+
+def test_transpile_fold_is_context_limited():
+    assert "0.05" in transpile("x >= 0.06 - 0.01")
+    assert "0.07" in transpile("x between 0.06 - 0.01 and 0.06 + 0.01")
+    # precedence traps must NOT fold
+    assert "1.0" not in transpile("select 0.5 + 0.5 * x from t")
+    assert "0.1" not in transpile("select 1 - 0.5 - 0.4 from t")

@@ -56,7 +56,7 @@ def _h64(x: np.ndarray, stream: int) -> np.ndarray:
 
 def _randint(keys: np.ndarray, stream: int, lo: int, hi: int) -> np.ndarray:
     """Uniform integer in [lo, hi] keyed by row id (inclusive)."""
-    return (lo + (_h64(keys, stream) % _U(hi - lo + 1))).astype(np.int64)
+    return (_h64(keys, stream) % _U(hi - lo + 1)).astype(np.int64) + lo
 
 
 def _uniform(keys: np.ndarray, stream: int) -> np.ndarray:

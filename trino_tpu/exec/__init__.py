@@ -1,0 +1,8 @@
+"""Execution engine: kernels, operators, driver, local planner.
+
+The data-plane replacement for core/trino-main's operator/ + execution/
+packages (reference: operator/Driver.java:66, operator/Operator.java:21,
+sql/planner/LocalExecutionPlanner.java:403), re-designed so that each
+pipeline's hot loop is one (or a few) jitted XLA programs instead of a
+bytecode-compiled per-row interpreter.
+"""

@@ -1,0 +1,61 @@
+"""Driver: moves batches through an operator chain.
+
+Mirrors Trino's Driver.processInternal hot loop (reference:
+operator/Driver.java:372 — ``page = current.getOutput(); next.addInput(page)``
+per adjacent operator pair, finish propagation, early close on satisfied
+LIMITs).  Single-threaded and synchronous: blocking here means an operator
+simply declines input until a bridge is ready, and pipelines are executed in
+dependency order by the task runner (build pipelines before probe pipelines —
+the moral equivalent of HashBuilder blocking LookupJoin via the
+LookupSourceFactory future).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .operators import Operator
+
+__all__ = ["Driver", "run_pipelines"]
+
+
+class Driver:
+    def __init__(self, operators: Sequence[Operator]):
+        assert operators, "empty pipeline"
+        self.operators = list(operators)
+
+    def run(self) -> None:
+        ops = self.operators
+        n = len(ops)
+        while not ops[-1].is_finished():
+            progressed = False
+            for i in range(n - 1):
+                cur, nxt = ops[i], ops[i + 1]
+                # early close: downstream done (e.g. LIMIT satisfied)
+                if nxt.is_finished() and not cur.is_finished():
+                    cur.close()
+                    progressed = True
+                    continue
+                if not cur.is_finished() and nxt.needs_input():
+                    page = cur.get_output()
+                    if page is not None:
+                        nxt.add_input(page)
+                        progressed = True
+                if cur.is_finished() and not nxt.input_done:
+                    nxt.finish_input()
+                    progressed = True
+            if ops[-1].is_finished():
+                break
+            if not progressed:
+                stuck = [type(o).__name__ for o in ops if not o.is_finished()]
+                raise RuntimeError(f"driver stalled; unfinished: {stuck}")
+        # upstream of an early-finished sink gets closed so sources release
+        for op in ops[:-1]:
+            if not op.is_finished():
+                op.close()
+
+
+def run_pipelines(pipelines: Sequence[Sequence[Operator]]) -> None:
+    """Execute pipelines in dependency order (build sides first)."""
+    for p in pipelines:
+        Driver(p).run()

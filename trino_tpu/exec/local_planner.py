@@ -1,0 +1,183 @@
+"""Local execution planner: plan tree → operator pipelines.
+
+The LocalExecutionPlanner equivalent (reference: sql/planner/
+LocalExecutionPlanner.java:403 — visitTableScan:2088, visitAggregation:1876,
+visitJoin:2449): walks the optimized plan bottom-up building one operator
+chain per pipeline; a join's build side becomes its own pipeline connected
+through a JoinBridge (mirrors createSubContext + JoinBridge wiring at
+LocalExecutionPlanner.java:2613).
+
+Pipelines come back in dependency order: every build pipeline precedes the
+pipeline that probes it, so a sequential run is correct (concurrent drivers
+arrive with the task executor).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..connectors.catalog import Catalog
+from ..planner import plan as P
+from ..spi.batch import Column, ColumnBatch
+from ..spi.types import Type
+from .operators import (
+    DistinctLimitOperator,
+    FilterProjectOperator,
+    HashAggregationOperator,
+    JoinBridge,
+    JoinBuildSink,
+    LimitOperator,
+    LookupJoinOperator,
+    Operator,
+    OutputCollector,
+    RenameOperator,
+    ScanOperator,
+    SemiJoinOperator,
+    SortOperator,
+    TableWriterOperator,
+    TopNOperator,
+    ValuesOperator,
+)
+
+__all__ = ["LocalExecutionPlan", "LocalPlanner"]
+
+
+class LocalExecutionPlan:
+    def __init__(self, pipelines: list[list[Operator]], collector: OutputCollector,
+                 output_names: Sequence[str], output_types: Sequence[Type]):
+        self.pipelines = pipelines
+        self.collector = collector
+        self.output_names = list(output_names)
+        self.output_types = list(output_types)
+
+
+class LocalPlanner:
+    def __init__(self, catalog: Catalog, splits_per_node: int = 4,
+                 node_count: int = 1):
+        self.catalog = catalog
+        self.splits_per_node = splits_per_node
+        self.node_count = node_count
+        self.pipelines: list[list[Operator]] = []
+
+    def plan(self, root: P.PlanNode) -> LocalExecutionPlan:
+        chain = self._chain(root)
+        collector = OutputCollector()
+        chain.append(collector)
+        self.pipelines.append(chain)
+        return LocalExecutionPlan(
+            self.pipelines, collector, root.output_names, root.output_types)
+
+    # ------------------------------------------------------------------
+    def _chain(self, node: P.PlanNode) -> list[Operator]:
+        if isinstance(node, P.TableScan):
+            conn = self.catalog.connector(node.catalog)
+            splits = conn.get_splits(
+                node.table, self.splits_per_node, self.node_count)
+            return [ScanOperator(conn, splits, node.columns)]
+
+        if isinstance(node, P.Filter):
+            chain = self._chain(node.source)
+            chain.append(FilterProjectOperator(
+                node.predicate, None, node.output_names, node.output_types))
+            return chain
+
+        if isinstance(node, P.Project):
+            chain = self._chain(node.source)
+            chain.append(FilterProjectOperator(
+                None, node.expressions, node.output_names, node.output_types))
+            return chain
+
+        if isinstance(node, P.Aggregate):
+            chain = self._chain(node.source)
+            chain.append(HashAggregationOperator(
+                node.group_keys, node.aggregates,
+                node.output_names, node.output_types, node.step))
+            return chain
+
+        if isinstance(node, P.Join):
+            bridge = JoinBridge()
+            build = self._chain(node.right)
+            build.append(JoinBuildSink(
+                bridge, node.right_keys,
+                node.right.output_types, node.right.output_names))
+            self.pipelines.append(build)
+            chain = self._chain(node.left)
+            chain.append(LookupJoinOperator(
+                bridge, node.left_keys, node.join_type, node.residual,
+                node.output_names, node.output_types))
+            return chain
+
+        if isinstance(node, P.SemiJoin):
+            bridge = JoinBridge()
+            build = self._chain(node.filter_source)
+            build.append(JoinBuildSink(
+                bridge, node.filter_keys,
+                node.filter_source.output_types, node.filter_source.output_names))
+            self.pipelines.append(build)
+            chain = self._chain(node.source)
+            chain.append(SemiJoinOperator(
+                bridge, node.source_keys, node.null_aware, node.residual,
+                node.output_names, node.output_types))
+            return chain
+
+        if isinstance(node, P.Sort):
+            chain = self._chain(node.source)
+            chain.append(SortOperator(node.keys))
+            return chain
+
+        if isinstance(node, P.TopN):
+            chain = self._chain(node.source)
+            chain.append(TopNOperator(node.count, node.keys))
+            return chain
+
+        if isinstance(node, P.Limit):
+            chain = self._chain(node.source)
+            chain.append(LimitOperator(node.count))
+            return chain
+
+        if isinstance(node, P.DistinctLimit):
+            chain = self._chain(node.source)
+            chain.append(DistinctLimitOperator(node.count))
+            return chain
+
+        if isinstance(node, P.Values):
+            batch = _values_batch(node)
+            return [ValuesOperator(batch)]
+
+        if isinstance(node, P.Output):
+            chain = self._chain(node.source)
+            chain.append(RenameOperator(node.output_names))
+            return chain
+
+        if isinstance(node, P.Exchange):
+            # single-node: exchanges are pass-through; the distributed task
+            # runner replaces these with collective/buffered edges
+            return self._chain(node.source)
+
+        if isinstance(node, P.TableWriter):
+            chain = self._chain(node.source)
+            conn = self.catalog.connector(node.catalog)
+            try:
+                conn.get_table_schema(node.table)
+            except KeyError:  # CTAS: create target from source schema
+                from ..spi.connector import ColumnSchema, TableSchema
+                conn.create_table(TableSchema(node.table, tuple(
+                    ColumnSchema(n, t) for n, t in
+                    zip(node.source.output_names, node.source.output_types))))
+            sink = conn.create_page_sink(node.table)
+            chain.append(TableWriterOperator(
+                sink,
+                on_finish=lambda frags: conn.finish_insert(node.table, frags)))
+            return chain
+
+        raise NotImplementedError(f"no operator for {type(node).__name__}")
+
+
+def _values_batch(node: P.Values) -> ColumnBatch:
+    cols = []
+    for i, t in enumerate(node.output_types):
+        vals = [row[i] for row in node.rows]
+        cols.append(Column.from_values(t, vals))
+    return ColumnBatch(list(node.output_names), cols)

@@ -1,0 +1,10 @@
+"""Distributed execution over a jax.sharding.Mesh.
+
+The TPU-native replacement for Trino's exchange data plane (reference:
+operator/output/PartitionedOutputOperator.java:47 hash-shuffle +
+operator/ExchangeOperator.java:44 consumer + execution/buffer/*OutputBuffer):
+when a stage's producing and consuming tasks are all TPU-resident, the
+repartition/broadcast/gather edges compile into XLA collectives
+(``all_to_all`` / ``all_gather`` / ``psum``) under ``shard_map`` riding ICI —
+there is no serialize → HTTP → deserialize hop at all.
+"""

@@ -277,6 +277,22 @@ class LogicalPlanner:
             group_irs = [Translator(rel.scope(outer)).translate(g)
                          for g in spec.group_by]
             rel, rewrite = self._plan_aggregation(rel, group_irs, collector, outer)
+
+            # validate BEFORE rewriting: every select subtree must be a
+            # group-by expression, an aggregate placeholder, or composed of
+            # those — a surviving bare InputRef references a pre-agg channel
+            def covered(e: RowExpression) -> bool:
+                if e in rewrite or isinstance(e, Literal):
+                    return True
+                if isinstance(e, Call):
+                    return all(covered(a) for a in e.args)
+                return False
+
+            for it, e in zip(select_items, select_irs):
+                if not covered(e):
+                    raise AnalysisError(
+                        f"'{it.expr}' must be an aggregate expression or "
+                        "appear in GROUP BY clause")
             select_irs = [rewrite_expr(e, rewrite) for e in select_irs]
             if having_ir is not None:
                 having_ir = rewrite_expr(having_ir, rewrite)
@@ -308,14 +324,6 @@ class LogicalPlanner:
                 names.append(it.expr.parts[-1])
             else:
                 names.append(f"_col{i}")
-        # validate: no leftover raw column refs when aggregated
-        if has_group or has_aggs:
-            allowed = set(range(rel.width))
-            for e in select_irs:
-                for x in walk(e):
-                    if isinstance(x, InputRef) and x.index not in allowed:
-                        raise AnalysisError(
-                            "expression must appear in GROUP BY or be aggregated")
         proj = Project(tuple(names), tuple(e.type for e in select_irs),
                        rel.node, tuple(select_irs))
         out = RelationPlan(proj, [None] * len(names))
@@ -667,7 +675,14 @@ class LogicalPlanner:
         jn = Join(names, types, src.node, proj, "LEFT",
                   tuple(och), tuple(range(nkeys)), None)
         new_rel = RelationPlan(jn, src.qualifiers + [None] * (nkeys + 1))
-        return new_rel, InputRef(types[-1], new_rel.width - 1)
+        ir: RowExpression = InputRef(types[-1], new_rel.width - 1)
+        # count over zero inner rows is 0, not NULL: the LEFT join null-
+        # extends missing groups, so coalesce the count back (Trino:
+        # TransformCorrelatedScalarAggregation's default-value projection)
+        if (isinstance(sel_ir, Call) and sel_ir.name == "$aggref"
+                and collector.calls[sel_ir.args[0].value][0] == "count"):
+            ir = Call(ir.type, "$coalesce", (ir, Literal(ir.type, 0)))
+        return new_rel, ir
 
 
 def _index_of(ir, irs):

@@ -1,0 +1,80 @@
+"""StandaloneQueryRunner: SQL string → result batch, in process.
+
+The single-node equivalent of the reference's StandaloneQueryRunner
+(core/trino-main/src/main/java/io/trino/testing/StandaloneQueryRunner.java):
+parse → plan → optimize → local-plan → drive.  The distributed runner
+(coordinator + workers + exchanges) layers on top of the same pieces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .connectors.catalog import Catalog, default_catalog
+from .exec.driver import run_pipelines
+from .exec.local_planner import LocalPlanner
+from .planner.logical import LogicalPlanner
+from .planner.optimizer import optimize
+from .planner.plan import PlanNode, plan_text
+from .spi.batch import ColumnBatch
+from .sql.parser import parse_statement
+
+__all__ = ["QueryResult", "StandaloneQueryRunner"]
+
+
+@dataclass
+class QueryResult:
+    names: list[str]
+    batch: ColumnBatch
+
+    def rows(self) -> list[tuple]:
+        return self.batch.to_pylist()
+
+
+@dataclass
+class Session:
+    """Per-query knobs (the SystemSessionProperties miniature)."""
+
+    default_catalog: str = "tpch"
+    splits_per_node: int = 4
+    node_count: int = 1
+
+
+class StandaloneQueryRunner:
+    def __init__(self, catalog: Optional[Catalog] = None,
+                 session: Optional[Session] = None):
+        self.catalog = catalog if catalog is not None else default_catalog()
+        self.session = session if session is not None else Session()
+
+    def create_plan(self, sql: str) -> PlanNode:
+        stmt = parse_statement(sql)
+        planner = LogicalPlanner(self.catalog, self.session.default_catalog)
+        plan = planner.plan(stmt)
+        return optimize(plan, self.catalog)
+
+    def explain(self, sql: str) -> str:
+        return plan_text(self.create_plan(sql))
+
+    def execute(self, sql: str) -> QueryResult:
+        plan = self.create_plan(sql)
+        local = LocalPlanner(
+            self.catalog,
+            splits_per_node=self.session.splits_per_node,
+            node_count=self.session.node_count,
+        ).plan(plan)
+        run_pipelines(local.pipelines)
+        batches = local.collector.batches
+        if batches:
+            batch = ColumnBatch.concat(batches)
+        else:
+            from .spi.batch import Column
+
+            batch = ColumnBatch(
+                local.output_names,
+                [Column(t, np.empty(0, t.storage_dtype))
+                 for t in local.output_types],
+            )
+        return QueryResult(local.output_names, batch)

@@ -80,6 +80,25 @@ def transpile(sql: str) -> str:
             interval_repl,
             out,
         )
+    # fold decimal-literal +/- decimal-literal exactly (sqlite would do it in
+    # binary float: 0.06 + 0.01 != 0.07 there, so BETWEEN endpoints miss rows
+    # that SQL decimal semantics include).  Folding only fires right after a
+    # comparison/BETWEEN/AND token so operator precedence and left-
+    # associativity can't change the value (never inside `a - b - c` chains
+    # or next to * and /).
+    def fold(m):
+        a, op, b = decimal.Decimal(m.group(2)), m.group(3), decimal.Decimal(m.group(4))
+        return m.group(1) + str(a + b if op == "+" else a - b)
+
+    prev = None
+    while prev != out:
+        prev = out
+        out = re.sub(
+            r"(?is)(between\s+|and\s+|[=<>]=?\s*)"
+            r"(\d+\.\d+)\s*([+-])\s*(\d+\.\d+)(?!\s*[*/])(?![\w.])",
+            fold,
+            out,
+        )
     out = re.sub(r"(?is)extract\s*\(\s*year\s+from\s+", "tpch_year(", out)
     out = re.sub(r"(?is)extract\s*\(\s*month\s+from\s+", "tpch_month(", out)
     out = re.sub(r"(?is)extract\s*\(\s*quarter\s+from\s+", "tpch_quarter(", out)

@@ -100,6 +100,46 @@ def test_distinct_count_null_storage_collision():
     assert list(res[0]) == [2]  # distinct {0, 5}
 
 
+def test_any_value_skips_nulls():
+    # group [7 (valid), NULL (storage fill 0)]: any_value must return 7
+    data = np.array([7, 0], dtype=np.int64)
+    valid = np.array([True, False])
+    gidk = np.zeros(2, dtype=np.int64)
+    perm, gid, n = K.group_ids([(gidk, None)])
+    (res,) = K.grouped_reduce(perm, gid, n,
+                              [("any_value", data, valid, np.int64, False)])
+    vals, v = res
+    assert list(vals) == [7] and list(v) == [True]
+
+
+def test_correlated_count_in_expression(runner):
+    # count wrapped in an expression: default value is the expression at
+    # count=0, i.e. 0+1=1 for every order with no matching lineitem
+    rows = runner.execute(
+        "select count(*) from orders o where 1 = "
+        "(select count(*) + 1 from lineitem l "
+        " where l.l_orderkey = o.o_orderkey and l.l_quantity < 0)"
+    ).rows()
+    assert rows == [(15000,)]
+
+
+def test_distributed_varchar_repartition():
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+
+    # count(distinct) forces a repartition keyed on a VARCHAR column; the
+    # routing must hash string values, not per-producer dictionary codes
+    sql = ("select n_name, count(distinct s_suppkey) from supplier, nation "
+           "where s_nationkey = n_nationkey group by n_name")
+    from trino_tpu.connectors.catalog import default_catalog
+
+    cat = default_catalog(0.01)
+    dist = DistributedQueryRunner(cat, worker_count=3)
+    sa = StandaloneQueryRunner(cat)
+    from trino_tpu.testing.oracle import assert_same_rows
+
+    assert_same_rows(dist.execute(sql).rows(), sa.execute(sql).rows())
+
+
 def test_transpile_fold_is_context_limited():
     assert "0.05" in transpile("x >= 0.06 - 0.01")
     assert "0.07" in transpile("x between 0.06 - 0.01 and 0.06 + 0.01")

@@ -51,13 +51,6 @@ def bucket(n: int, minimum: int = 8) -> int:
     return c
 
 
-def _null_low_key(data, valid):
-    """Sort key where NULLs compare equal-and-first; data is pre-filled."""
-    if valid is None:
-        return data, None
-    return data, valid
-
-
 # ---------------------------------------------------------------------------
 # grouped aggregation: sort -> boundary-detect -> segment reduce
 
@@ -209,7 +202,9 @@ def _reduce_fn(spec: tuple, cap: int):
                 )
                 outs.append((r, anyv))
             elif fname == "any_value":
-                r = jnp.zeros((cap,), data.dtype).at[gid].set(data)
+                # scatter only VALID rows (NULL lanes carry storage fill)
+                tgt = gid if valid is None else jnp.where(valid, gid, cap)
+                r = jnp.zeros((cap + 1,), data.dtype).at[tgt].set(data)[:cap]
                 anyv = (
                     None
                     if valid is None
@@ -392,16 +387,21 @@ def _probe_ranges_fn():
 
 
 @lru_cache(maxsize=None)
-def _expand_fn(total: int):
+def _expand_fn(cap: int):
+    """Expansion kernel sized to a power-of-two bucket ``cap`` >= total so
+    varying per-batch match counts reuse a handful of compiled programs;
+    slots >= total produce clamped garbage the caller slices off."""
+
     @jax.jit
     def fn(lo, counts, perm):
+        n = counts.shape[0]
         ends = jnp.cumsum(counts)
         starts = ends - counts
-        slot = jnp.arange(total)
-        probe_id = jnp.searchsorted(ends, slot, side="right")
+        slot = jnp.arange(cap)
+        probe_id = jnp.clip(jnp.searchsorted(ends, slot, side="right"), 0, n - 1)
         within = slot - starts[probe_id]
         build_pos = lo[probe_id] + within
-        return probe_id, perm[build_pos]
+        return probe_id, perm[jnp.clip(build_pos, 0, perm.shape[0] - 1)]
 
     return fn
 
@@ -441,7 +441,8 @@ def probe_join_table(
     total = int(np.asarray(jnp.sum(counts)))
     if total == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
-    probe_id, build_id = _expand_fn(total)(lo, counts, table.perm)
+    probe_id, build_id = _expand_fn(bucket(total))(lo, counts, table.perm)
+    probe_id, build_id = probe_id[:total], build_id[:total]
     # exact verification (hash candidates -> equality on every key column)
     ok = jnp.ones((total,), jnp.bool_)
     for (pd, pv), bd in zip(probe_keys, table.key_datas):

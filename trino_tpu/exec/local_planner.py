@@ -55,10 +55,16 @@ class LocalExecutionPlan:
 
 class LocalPlanner:
     def __init__(self, catalog: Catalog, splits_per_node: int = 4,
-                 node_count: int = 1):
+                 node_count: int = 1, task_index: int = 0,
+                 task_count: int = 1, remote_clients=None):
         self.catalog = catalog
         self.splits_per_node = splits_per_node
         self.node_count = node_count
+        # distributed: this task's share of splits + exchange clients per
+        # upstream fragment id (filled by the stage scheduler)
+        self.task_index = task_index
+        self.task_count = task_count
+        self.remote_clients = remote_clients or {}
         self.pipelines: list[list[Operator]] = []
 
     def plan(self, root: P.PlanNode) -> LocalExecutionPlan:
@@ -75,7 +81,15 @@ class LocalPlanner:
             conn = self.catalog.connector(node.catalog)
             splits = conn.get_splits(
                 node.table, self.splits_per_node, self.node_count)
-            return [ScanOperator(conn, splits, node.columns)]
+            mine = [s for i, s in enumerate(splits)
+                    if i % self.task_count == self.task_index]
+            return [ScanOperator(conn, mine, node.columns)]
+
+        if isinstance(node, P.RemoteSource):
+            from ..execution.task import RemoteExchangeSourceOperator
+
+            client = self.remote_clients[node.fragment_id]
+            return [RemoteExchangeSourceOperator(client)]
 
         if isinstance(node, P.Filter):
             chain = self._chain(node.source)

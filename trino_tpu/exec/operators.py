@@ -295,27 +295,36 @@ class HashAggregationOperator(Operator):
         super().finish_input()
         self._result = self._compute()
 
+    def _empty_result(self, nk: int) -> ColumnBatch:
+        if nk:  # grouped agg over empty input -> empty result
+            cols = [Column(t, np.empty(0, t.storage_dtype))
+                    for t in self.output_types]
+            return ColumnBatch(self.output_names, cols)
+        # global agg over empty input -> one row of defaults
+        cols = []
+        i = 0
+        for a in self.aggs:
+            if self.step == "PARTIAL" and a.fn == "avg":
+                cols.append(Column(self.output_types[i],
+                                   np.zeros(1, np.float64), np.zeros(1, bool)))
+                cols.append(Column(self.output_types[i + 1], np.zeros(1, np.int64)))
+                i += 2
+                continue
+            t = self.output_types[i]
+            i += 1
+            if a.fn == "count":
+                cols.append(Column(t, np.zeros(1, np.int64)))
+            else:
+                cols.append(Column(t, np.zeros(1, t.storage_dtype),
+                                   np.zeros(1, bool)))
+        return ColumnBatch(self.output_names, cols)
+
     def _compute(self) -> ColumnBatch:
-        if self._batches:
-            inp = ColumnBatch.concat(self._batches)
-        else:
-            inp = None
+        inp = ColumnBatch.concat(self._batches) if self._batches else None
         n = inp.num_rows if inp is not None else 0
         nk = len(self.group_keys)
         if n == 0:
-            if nk:  # grouped agg over empty input -> empty result
-                cols = [Column(t, np.empty(0, t.storage_dtype))
-                        for t in self.output_types]
-                return ColumnBatch(self.output_names, cols)
-            # global agg over empty input -> one row of defaults
-            cols = []
-            for a, t in zip(self.aggs, self.output_types):
-                if a.fn == "count":
-                    cols.append(Column(t, np.zeros(1, np.int64)))
-                else:
-                    cols.append(Column(t, np.zeros(1, t.storage_dtype),
-                                       np.zeros(1, bool)))
-            return ColumnBatch(self.output_names, cols)
+            return self._empty_result(nk)
 
         if nk:
             key_cols = [inp.columns[i] for i in self.group_keys]
@@ -330,15 +339,32 @@ class HashAggregationOperator(Operator):
             gid = np.zeros(n, np.int32)
             num_groups = 1
 
-        # expand avg -> (sum, count) kernel pairs
+        # kernel specs; avg expands to (sum, count) state pairs.  FINAL
+        # merges partial states: count -> sum of counts, others same fn.
         specs, avg_slots = [], {}
-        for idx, (a, t) in enumerate(
-            zip(self.aggs, self.output_types[nk:])
-        ):
-            s = self._agg_spec(a, inp, t)
+        for idx, a in enumerate(self.aggs):
+            if self.step == "FINAL":
+                c = inp.columns[a.arg]
+                data = np.asarray(c.data)
+                valid = None if c.valid is None else np.asarray(c.valid)
+                if a.fn == "avg":
+                    avg_slots[idx] = len(specs)
+                    c2 = inp.columns[a.arg + 1]
+                    specs.append(("sum", data, valid, np.float64, False))
+                    specs.append(("sum", np.asarray(c2.data), None, np.int64, False))
+                elif a.fn in ("count", "count_star"):
+                    specs.append(("sum", data, None, np.int64, False))
+                else:
+                    specs.append((a.fn, data, valid, data.dtype, False))
+                continue
+            s = self._agg_spec(a, inp, a.type)
             if s[0] == "avg":
                 avg_slots[idx] = len(specs)
-                specs.append(("sum", s[1].astype(np.float64), s[2], np.float64, s[4]))
+                scale = 0
+                if a.arg >= 0 and isinstance(inp.columns[a.arg].type, DecimalType):
+                    scale = inp.columns[a.arg].type.scale
+                sum_data = s[1].astype(np.float64) / (10 ** scale)
+                specs.append(("sum", sum_data, s[2], np.float64, s[4]))
                 specs.append(("count", s[1], s[2], np.int64, s[4]))
             else:
                 specs.append(s)
@@ -348,15 +374,21 @@ class HashAggregationOperator(Operator):
         for (d, v), c in zip(keys_out, key_cols):
             out_cols.append(Column(c.type, d, v, c.dictionary))
         ri = 0
-        for idx, (a, t) in enumerate(zip(self.aggs, self.output_types[nk:])):
+        for idx, a in enumerate(self.aggs):
+            t = self.output_types[len(out_cols)]
             if idx in avg_slots:
                 s_data, s_valid = reduced[ri]
                 c_data, _ = reduced[ri + 1]
                 ri += 2
+                if self.step == "PARTIAL":
+                    # emit mergeable states: scale-free sum + count
+                    sv = None if (s_valid is None or s_valid.all()) else s_valid
+                    out_cols.append(Column(t, s_data.astype(np.float64), sv))
+                    out_cols.append(Column(self.output_types[len(out_cols)],
+                                           c_data.astype(np.int64)))
+                    continue
                 cnt = np.maximum(c_data, 1)
-                arg_t = None if a.arg < 0 else inp.columns[a.arg].type
-                scale = arg_t.scale if isinstance(arg_t, DecimalType) else 0
-                vals = (s_data / (10 ** scale)) / cnt
+                vals = s_data / cnt
                 valid = (c_data > 0)
                 if s_valid is not None:
                     valid = valid & s_valid
@@ -366,14 +398,17 @@ class HashAggregationOperator(Operator):
             d, v = reduced[ri]
             ri += 1
             if a.fn in ("sum", "min", "max", "any_value"):
-                # all-NULL group (or empty via filter) -> NULL
                 if v is not None:
                     v = None if v.all() else v
             else:
                 v = None  # count never NULL
+            dict_ = None
+            if self.step != "FINAL" and a.arg >= 0:
+                dict_ = inp.columns[a.arg].dictionary
+            elif self.step == "FINAL" and a.fn in ("min", "max", "any_value"):
+                dict_ = inp.columns[a.arg].dictionary
             out_cols.append(Column(t, d.astype(t.storage_dtype, copy=False), v,
-                                   getattr(inp.columns[a.arg], "dictionary", None)
-                                   if a.arg >= 0 else None))
+                                   dict_))
         return ColumnBatch(self.output_names, out_cols)
 
     def get_output(self) -> Optional[ColumnBatch]:

@@ -1,0 +1,7 @@
+"""Distributed query execution: fragments, stages, tasks, exchanges.
+
+The L3-L5 layers of SURVEY §1 (reference: execution/SqlTaskExecution.java:85,
+execution/scheduler/PipelinedQueryScheduler.java:157, execution/buffer/*):
+a fragmented plan runs as a tree of stages, each stage as N concurrent
+tasks, wired by pull-token output buffers.
+"""

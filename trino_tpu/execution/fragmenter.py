@@ -1,0 +1,122 @@
+"""PlanFragmenter: cut the distributed plan at REMOTE exchanges.
+
+Mirrors sql/planner/PlanFragmenter.java:94 (``createSubPlans:124``): every
+``Exchange(scope=REMOTE)`` boundary becomes a fragment edge; the consumer
+side sees a ``RemoteSource`` leaf naming the producer fragment.  Fragment
+partitioning (how many tasks execute it) follows SystemPartitioningHandle:
+SOURCE (split-driven leaf), HASH (repartition consumer), SINGLE (gather
+consumer / coordinator stage).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..planner.plan import (
+    Exchange,
+    PlanNode,
+    RemoteSource,
+    TableScan,
+    plan_text,
+)
+
+__all__ = ["PlanFragment", "SubPlan", "fragment_plan"]
+
+
+@dataclass
+class PlanFragment:
+    id: int
+    root: PlanNode
+    partitioning: str          # SOURCE | HASH | SINGLE
+    output_kind: str           # GATHER | REPARTITION | BROADCAST | OUTPUT
+    output_keys: tuple[int, ...]
+    source_fragments: list[int]
+
+
+@dataclass
+class SubPlan:
+    fragment: PlanFragment
+    children: list["SubPlan"]
+
+    def all_fragments(self) -> list[PlanFragment]:
+        out = []
+        for c in self.children:
+            out.extend(c.all_fragments())
+        out.append(self.fragment)
+        return out
+
+    def text(self) -> str:
+        lines = []
+        for f in self.all_fragments():
+            lines.append(
+                f"Fragment {f.id} [{f.partitioning} -> {f.output_kind}"
+                + (f" keys={list(f.output_keys)}" if f.output_keys else "")
+                + f" sources={f.source_fragments}]")
+            lines.append(plan_text(f.root, 1))
+        return "\n".join(lines)
+
+
+class _Fragmenter:
+    def __init__(self):
+        self.next_id = 0
+        self.subplans: dict[int, SubPlan] = {}
+
+    def fragment(self, node: PlanNode, output_kind: str,
+                 output_keys: tuple[int, ...]) -> SubPlan:
+        fid = self.next_id
+        self.next_id += 1
+        sources: list[int] = []
+        children: list[SubPlan] = []
+        root = self._rewrite(node, sources, children)
+        partitioning = self._partitioning(root)
+        frag = PlanFragment(fid, root, partitioning, output_kind,
+                            output_keys, sources)
+        sp = SubPlan(frag, children)
+        self.subplans[fid] = sp
+        return sp
+
+    def _rewrite(self, node: PlanNode, sources: list[int],
+                 children: list[SubPlan]) -> PlanNode:
+        if isinstance(node, Exchange) and node.scope == "REMOTE":
+            child = self.fragment(node.source, node.kind, node.partition_keys)
+            sources.append(child.fragment.id)
+            children.append(child)
+            return RemoteSource(node.output_names, node.output_types,
+                               child.fragment.id, node.kind)
+        kids = node.children
+        if not kids:
+            return node
+        new_kids = [self._rewrite(c, sources, children) for c in kids]
+        if all(a is b for a, b in zip(kids, new_kids)):
+            return node
+        if len(kids) == 1:
+            return replace(node, source=new_kids[0])
+        return replace(node, left=new_kids[0], right=new_kids[1]) \
+            if hasattr(node, "left") else \
+            replace(node, source=new_kids[0], filter_source=new_kids[1])
+
+    @staticmethod
+    def _partitioning(root: PlanNode) -> str:
+        has_scan = False
+        kinds = []
+
+        def walk(n: PlanNode):
+            nonlocal has_scan
+            if isinstance(n, TableScan):
+                has_scan = True
+            if isinstance(n, RemoteSource):
+                kinds.append(n.kind)
+            for c in n.children:
+                walk(c)
+
+        walk(root)
+        if has_scan:
+            return "SOURCE"
+        if "REPARTITION" in kinds:
+            return "HASH"
+        return "SINGLE"
+
+
+def fragment_plan(root: PlanNode) -> SubPlan:
+    """Root fragment is the coordinator (OUTPUT) stage."""
+    return _Fragmenter().fragment(root, "OUTPUT", ())

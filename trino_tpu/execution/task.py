@@ -1,0 +1,101 @@
+"""Task-side exchange operators + task execution.
+
+RemoteExchangeSourceOperator = operator/ExchangeOperator.java:44 (pulls
+upstream pages through an ExchangeClient); PartitionedOutputSink =
+operator/output/PartitionedOutputOperator.java:47 + TaskOutputOperator
+(hash/broadcast/gather placement into the task's OutputBuffer).
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..exec import kernels as K
+from ..exec.operators import Operator
+from ..spi.batch import Column, ColumnBatch
+from .exchange import ExchangeClient, OutputBuffer
+
+__all__ = ["RemoteExchangeSourceOperator", "PartitionedOutputSink"]
+
+
+def _dict_value_hashes(dictionary: np.ndarray) -> np.ndarray:
+    """Deterministic per-value hash of a string dictionary (crc32 over
+    utf-8).  Partition routing must hash VALUES, not dictionary codes: code
+    3 in one producer's dictionary is a different string than code 3 in
+    another's, and all producers of a stage must route equal values to the
+    same consumer task."""
+    return np.array([zlib.crc32(str(s).encode()) for s in dictionary],
+                    dtype=np.int64)
+
+
+def _partition_key_tuple(c: Column):
+    data = np.asarray(c.data)
+    valid = None if c.valid is None else np.asarray(c.valid)
+    if c.dictionary is not None:
+        vh = _dict_value_hashes(c.dictionary)
+        data = vh[data] if len(vh) else np.zeros(len(data), np.int64)
+    return data, valid
+
+
+class RemoteExchangeSourceOperator(Operator):
+    def __init__(self, client: ExchangeClient):
+        self.client = client
+        self.input_done = True
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        if self._closed:
+            return None
+        # block until a page or all upstream producers finish; the driver
+        # treats a None from a non-finished source as "try again"
+        deadline = time.monotonic() + 300.0
+        while not self.client.is_finished():
+            page = self.client.poll(timeout=0.2)
+            if page is not None:
+                return page
+            if time.monotonic() > deadline:
+                raise TimeoutError("exchange source stalled >300s")
+        return None
+
+    def is_finished(self) -> bool:
+        return self._closed or self.client.is_finished()
+
+
+class PartitionedOutputSink(Operator):
+    """Routes task output into the OutputBuffer: REPARTITION hashes on the
+    output keys, BROADCAST replicates, GATHER/OUTPUT lands in partition 0."""
+
+    def __init__(self, buffer: OutputBuffer, kind: str,
+                 keys: Sequence[int] = ()):
+        self.buffer = buffer
+        self.kind = kind
+        self.keys = list(keys)
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        n = self.buffer.num_partitions
+        if self.kind == "REPARTITION" and n > 1:
+            cols = [batch.columns[k] for k in self.keys]
+            parts = K.partition_assignments(
+                [_partition_key_tuple(c) for c in cols], n)
+            for p in range(n):
+                sub = batch.filter(parts == p)
+                if sub.num_rows:
+                    self.buffer.enqueue(p, sub)
+        elif self.kind == "BROADCAST" and n > 1:
+            for p in range(n):
+                self.buffer.enqueue(p, batch)
+        else:
+            self.buffer.enqueue(0, batch)
+
+    def finish_input(self) -> None:
+        super().finish_input()
+        self.buffer.set_finished()
+
+    def is_finished(self) -> bool:
+        return self.input_done

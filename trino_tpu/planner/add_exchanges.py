@@ -1,0 +1,201 @@
+"""AddExchanges: place REMOTE exchange boundaries + split aggregations.
+
+The distribution-planning pass (reference: sql/planner/optimizations/
+AddExchanges.java:138, chooses SystemPartitioningHandle.java:48-57
+partitionings).  Transforms a single-node plan into a distributed one:
+
+- ``Aggregate(SINGLE)`` → ``Aggregate(FINAL) ∘ Exchange(REPARTITION keys)
+  ∘ Aggregate(PARTIAL)`` — the classic two-phase aggregation.  The PARTIAL
+  step emits mergeable state columns (``avg`` expands to sum+count, scale
+  folded in so states are scale-free); distinct aggregates cannot pre-
+  aggregate, so they repartition raw rows and aggregate SINGLE after.
+- global ``Aggregate`` (no keys) → FINAL after ``Exchange(GATHER)``.
+- ``Join(BROADCAST)`` → build side wrapped in ``Exchange(BROADCAST)``
+  (BroadcastOutputBuffer path); ``Join(PARTITIONED)`` → both sides hash-
+  repartitioned on the join keys (FIXED_HASH_DISTRIBUTION).
+- ``Sort/TopN/Limit/DistinctLimit`` → partial on workers, final above a
+  ``GATHER`` (mirrors Limit/TopN splitting rules).
+- ``Output``/``TableWriter`` root runs single (coordinator gather).
+
+Leaf fragments stay SOURCE-partitioned (split-driven).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..spi.types import BIGINT, DOUBLE, DecimalType, Type
+from .plan import (
+    Aggregate,
+    AggCall,
+    DistinctLimit,
+    Exchange,
+    Filter,
+    Join,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    SemiJoin,
+    Sort,
+    TableScan,
+    TableWriter,
+    TopN,
+    Values,
+)
+
+__all__ = ["add_exchanges", "partial_agg_layout"]
+
+
+def partial_agg_layout(aggs, input_types) -> list[tuple[str, Type, int]]:
+    """Per original AggCall: list of (state_fn, state_type, width) describing
+    the PARTIAL output columns.  avg → [(sum,f64),(count,i64)] with the
+    decimal scale folded into the sum state."""
+    out = []
+    for a in aggs:
+        if a.fn == "avg":
+            out.append([("avg_sum", DOUBLE), ("avg_count", BIGINT)])
+        elif a.fn == "count":
+            out.append([("count", BIGINT)])
+        else:
+            t = a.type
+            out.append([(a.fn, t)])
+    return out
+
+
+def add_exchanges(root: PlanNode) -> PlanNode:
+    return _visit(root, single=True)
+
+
+def _exchange(node: PlanNode, kind: str, keys=()) -> Exchange:
+    return Exchange(node.output_names, node.output_types, node, kind,
+                    "REMOTE", tuple(keys))
+
+
+def _visit(node: PlanNode, single: bool) -> PlanNode:
+    """Rewrite bottom-up.  ``single`` = the parent requires this subtree's
+    output to arrive at one task (root stage)."""
+
+    if isinstance(node, Aggregate):
+        return _split_aggregate(node, single)
+
+    if isinstance(node, Join):
+        left = _visit(node.left, single=False)
+        right = _visit(node.right, single=False)
+        if node.distribution == "PARTITIONED" and node.left_keys:
+            left = _exchange(left, "REPARTITION", node.left_keys)
+            right = _exchange(right, "REPARTITION", node.right_keys)
+        else:
+            right = _exchange(right, "BROADCAST")
+        out = Join(node.output_names, node.output_types, left, right,
+                   node.join_type, node.left_keys, node.right_keys,
+                   node.residual, node.distribution)
+        return _gather_if(out, single)
+
+    if isinstance(node, SemiJoin):
+        src = _visit(node.source, single=False)
+        filt = _visit(node.filter_source, single=False)
+        filt = _exchange(filt, "BROADCAST")
+        out = SemiJoin(node.output_names, node.output_types, src, filt,
+                       node.source_keys, node.filter_keys, node.negated,
+                       node.residual, node.null_aware)
+        return _gather_if(out, single)
+
+    if isinstance(node, Sort):
+        src = _visit(node.source, single=False)
+        src = _exchange(src, "GATHER")
+        return Sort(node.output_names, node.output_types, src, node.keys)
+
+    if isinstance(node, TopN):
+        src = _visit(node.source, single=False)
+        partial = TopN(node.output_names, node.output_types, src,
+                       node.count, node.keys)
+        gathered = _exchange(partial, "GATHER")
+        return TopN(node.output_names, node.output_types, gathered,
+                    node.count, node.keys)
+
+    if isinstance(node, Limit):
+        src = _visit(node.source, single=False)
+        partial = Limit(node.output_names, node.output_types, src, node.count)
+        gathered = _exchange(partial, "GATHER")
+        return Limit(node.output_names, node.output_types, gathered, node.count)
+
+    if isinstance(node, DistinctLimit):
+        src = _visit(node.source, single=False)
+        partial = DistinctLimit(node.output_names, node.output_types, src,
+                                node.count)
+        gathered = _exchange(partial, "GATHER")
+        return DistinctLimit(node.output_names, node.output_types, gathered,
+                             node.count)
+
+    if isinstance(node, (Output, TableWriter)):
+        src = _visit(node.source, single=True)
+        return _replace_source(node, src)
+
+    if isinstance(node, (Filter, Project)):
+        src = _visit(node.source, single=single)
+        return _replace_source(node, src)
+
+    if isinstance(node, (TableScan, Values)):
+        return _gather_if(node, single)
+
+    if isinstance(node, Exchange):  # already placed (LOCAL exchanges later)
+        return _replace_source(node, _visit(node.source, single=False))
+
+    raise NotImplementedError(f"add_exchanges: {type(node).__name__}")
+
+
+def _replace_source(node, src):
+    from dataclasses import replace
+
+    return replace(node, source=src)
+
+
+def _gather_if(node: PlanNode, single: bool) -> PlanNode:
+    if single:
+        return _exchange(node, "GATHER")
+    return node
+
+
+def _split_aggregate(node: Aggregate, single: bool) -> PlanNode:
+    src = _visit(node.source, single=False)
+    nk = len(node.group_keys)
+    has_distinct = any(a.distinct for a in node.aggregates)
+
+    if has_distinct:
+        # distinct can't pre-aggregate: repartition raw rows on the group
+        # keys (or gather when global), aggregate SINGLE at the consumer
+        if nk:
+            src = _exchange(src, "REPARTITION", node.group_keys)
+        else:
+            src = _exchange(src, "GATHER")
+        out = Aggregate(node.output_names, node.output_types, src,
+                        node.group_keys, node.aggregates, "SINGLE")
+        return _gather_if(out, single and nk > 0)
+
+    # ---- PARTIAL ----------------------------------------------------------
+    layouts = partial_agg_layout(node.aggregates, src.output_types)
+    p_names = [src.output_names[c] for c in node.group_keys]
+    p_types = [src.output_types[c] for c in node.group_keys]
+    for i, states in enumerate(layouts):
+        for j, (fn, t) in enumerate(states):
+            p_names.append(f"_s{i}_{j}")
+            p_types.append(t)
+    partial = Aggregate(tuple(p_names), tuple(p_types), src,
+                        node.group_keys, node.aggregates, "PARTIAL")
+
+    # ---- exchange ---------------------------------------------------------
+    if nk:
+        ex = _exchange(partial, "REPARTITION", tuple(range(nk)))
+    else:
+        ex = _exchange(partial, "GATHER")
+
+    # ---- FINAL: same call list; args point at the first state channel -----
+    f_calls = []
+    ch = nk
+    for a, states in zip(node.aggregates, layouts):
+        f_calls.append(AggCall(a.fn, ch, a.type, False))
+        ch += len(states)
+    final = Aggregate(node.output_names, node.output_types, ex,
+                      tuple(range(nk)), tuple(f_calls), "FINAL")
+    return _gather_if(final, single and nk > 0)

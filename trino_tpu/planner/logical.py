@@ -677,11 +677,18 @@ class LogicalPlanner:
         new_rel = RelationPlan(jn, src.qualifiers + [None] * (nkeys + 1))
         ir: RowExpression = InputRef(types[-1], new_rel.width - 1)
         # count over zero inner rows is 0, not NULL: the LEFT join null-
-        # extends missing groups, so coalesce the count back (Trino:
-        # TransformCorrelatedScalarAggregation's default-value projection)
-        if (isinstance(sel_ir, Call) and sel_ir.name == "$aggref"
-                and collector.calls[sel_ir.args[0].value][0] == "count"):
-            ir = Call(ir.type, "$coalesce", (ir, Literal(ir.type, 0)))
+        # extends missing groups, so coalesce back the value the expression
+        # takes at count=0 (Trino: TransformCorrelatedScalarAggregation's
+        # default-value projection).  Only count-family aggregates have a
+        # non-NULL zero-row value; sum/min/max are NULL over no rows, which
+        # the null-extension already produces.
+        aggrefs = [x for x in walk(sel_ir)
+                   if isinstance(x, Call) and x.name == "$aggref"]
+        if aggrefs and all(collector.calls[a.args[0].value][0] == "count"
+                           for a in aggrefs):
+            subst = {a: Literal(a.type, 0) for a in aggrefs}
+            default_expr = rewrite_expr(sel_ir, subst)
+            ir = Call(ir.type, "$coalesce", (ir, default_expr))
         return new_rel, ir
 
 

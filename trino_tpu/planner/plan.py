@@ -21,7 +21,8 @@ from ..sql.ir import RowExpression
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
-    "Output", "Exchange", "TableWriter", "DistinctLimit", "plan_text",
+    "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
+    "plan_text",
 ]
 
 
@@ -248,6 +249,19 @@ class Exchange(PlanNode):
     def label(self) -> str:
         keys = f" keys={list(self.partition_keys)}" if self.partition_keys else ""
         return f"Exchange[{self.scope} {self.kind}{keys}]"
+
+
+@dataclass(frozen=True)
+class RemoteSource(PlanNode):
+    """Reads a remote fragment's output inside a downstream fragment
+    (mirrors sql/planner/plan/RemoteSourceNode.java).  ``fragment_id``
+    names the producing fragment; ``kind`` echoes the exchange type."""
+
+    fragment_id: int = -1
+    kind: str = "GATHER"
+
+    def label(self) -> str:
+        return f"RemoteSource[f{self.fragment_id} {self.kind}]"
 
 
 @dataclass(frozen=True)

@@ -51,6 +51,26 @@ def bucket(n: int, minimum: int = 8) -> int:
     return c
 
 
+def _canon_float(x):
+    """Canonicalize float keys so hashing/grouping agree with SQL equality:
+    -0.0 -> +0.0 (they compare equal but have different bits) and every NaN
+    to the one canonical quiet-NaN pattern (NaN is a single GROUP BY value —
+    Trino treats NaN as equal to itself for grouping/joining).  The positive
+    canonical NaN also keeps all NaNs adjacent under XLA's total-order sort
+    (-NaN sorts first, +NaN last)."""
+    x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+    return jnp.where(jnp.isnan(x), jnp.full((), jnp.nan, x.dtype), x)
+
+
+def _neq(a, b):
+    """Elementwise 'different group key' compare: IEEE != except that NaN
+    equals NaN (SQL grouping semantics)."""
+    r = a != b
+    if np.dtype(a.dtype).kind == "f":
+        r = r & ~(jnp.isnan(a) & jnp.isnan(b))
+    return r
+
+
 # ---------------------------------------------------------------------------
 # grouped aggregation: sort -> boundary-detect -> segment reduce
 
@@ -66,6 +86,11 @@ def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...]):
         vmap = {}
         vi = 0
         for i in range(num_keys):
+            if np.dtype(datas[i].dtype).kind == "f":
+                # keys stay float (64-bit bitcasts don't survive the TPU x64
+                # rewrite); canonicalization makes NaNs sort adjacent and the
+                # NaN-aware boundary compare below makes them one group
+                datas[i] = _canon_float(datas[i])
             if has_valid[i]:
                 v = valids[vi]
                 vi += 1
@@ -81,7 +106,7 @@ def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...]):
         new_group = jnp.zeros(datas[0].shape, dtype=jnp.bool_)
         for i in range(num_keys):
             d = datas[i][perm]
-            diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), d[1:] != d[:-1]])
+            diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), _neq(d[1:], d[:-1])])
             if i in vmap:
                 v = vmap[i][perm]
                 diff = diff | jnp.concatenate(
@@ -154,6 +179,8 @@ def _reduce_fn(spec: tuple, cap: int):
                 # value) dedup: mark first occurrence within (gid, valid,
                 # value) runs — validity participates so a NULL row whose
                 # storage fill collides with a real value stays its own run
+                if np.dtype(data.dtype).kind == "f":
+                    data = _canon_float(data)  # NaN is ONE distinct value
                 if valid is not None:
                     order = jnp.lexsort((data, valid, gid))
                     v2 = valid[order]
@@ -162,7 +189,7 @@ def _reduce_fn(spec: tuple, cap: int):
                     v2 = None
                 d2, g2 = data[order], gid[order]
                 first = jnp.concatenate(
-                    [jnp.ones((1,), jnp.bool_), (d2[1:] != d2[:-1]) | (g2[1:] != g2[:-1])]
+                    [jnp.ones((1,), jnp.bool_), _neq(d2[1:], d2[:-1]) | (g2[1:] != g2[:-1])]
                 )
                 if v2 is not None:
                     first = first | jnp.concatenate(
@@ -281,14 +308,24 @@ def sort_perm(keys: Sequence[tuple]) -> np.ndarray:
         if not ascending:
             if kind == "b":
                 d = ~d
+            elif kind == "f":
+                d = -d.astype(jnp.float64)
             else:
-                d = -d.astype(jnp.float64) if kind == "f" else -d.astype(jnp.int64)
+                # bitwise NOT is a bijective order reversal; unary minus maps
+                # INT64_MIN to itself under two's-complement wraparound
+                d = ~d.astype(jnp.int64)
+        nan_rank = None
         if kind == "f":
-            # NaN sorts largest (Trino convention); after the descending
-            # negation above that means mapping NaN to -inf instead
+            # NaN sorts largest (Trino convention) via its own rank column —
+            # mapping NaN into the value domain (+/-inf) would tie with real
+            # infinities; the rank is more significant than the value
             nan = jnp.isnan(d)
-            d = jnp.where(nan, jnp.inf if ascending else -jnp.inf, d)
+            nan_rank = jnp.where(nan, 1 if ascending else 0,
+                                 0 if ascending else 1)
+            d = jnp.where(nan, jnp.zeros((), d.dtype), d)
         sort_cols.append(d)
+        if nan_rank is not None:
+            sort_cols.append(nan_rank)
         if valid is not None:
             v = jnp.asarray(valid)
             # secondary column is sorted after; null rank must be primary
@@ -311,6 +348,37 @@ def _mix64(h):
     return h ^ (h >> 31)
 
 
+def _f64_hash_word(a):
+    """Full-entropy uint64 hash word for a canonical float64 column, built
+    arithmetically — the TPU x64 rewrite cannot compile any 64-bit bitcast
+    (f64->u64, f64->2xu32 and frexp all fail).  The value is range-reduced
+    into an f32-friendly window by a log2-derived class, then split into
+    three float32 words whose cascade captures the whole 53-bit significand
+    (24*3 > 53), so equal doubles hash equal and distinct doubles collide
+    with negligible probability across the full f64 range."""
+    fin = jnp.isfinite(a)
+    mag = jnp.abs(a)
+    safe_mag = jnp.where(mag > 0, mag, 1.0)
+    cls = jnp.clip(jnp.floor(jnp.log2(safe_mag) / 120.0), -9.0, 9.0)
+    s = 2.0 ** (-60.0 * cls)  # applied twice; 2**(-120*cls) would overflow
+    scaled = jnp.where(fin, a * s * s, 0.0)
+    w1 = scaled.astype(jnp.float32)
+    r1 = scaled - w1.astype(jnp.float64)
+    w2 = r1.astype(jnp.float32)
+    r2 = r1 - w2.astype(jnp.float64)
+    w3 = r2.astype(jnp.float32)
+    tag = jnp.where(jnp.isnan(a), 3, jnp.where(a == jnp.inf, 1,
+                    jnp.where(a == -jnp.inf, 2, 0)))
+    meta = (cls.astype(jnp.int32) + 16) | (tag.astype(jnp.int32) << 8)
+
+    def u32(w):
+        return jax.lax.bitcast_convert_type(w, jnp.uint32).astype(jnp.uint64)
+
+    lo = u32(w1) | (u32(w2) << 32)
+    hi = u32(w3) | (meta.astype(jnp.uint32).astype(jnp.uint64) << 32)
+    return _mix64(lo) ^ hi
+
+
 def hash_combine(datas: Sequence) -> jnp.ndarray:
     """Combine n key columns into one uint64 hash lane (splitmix64 mix).
 
@@ -322,7 +390,7 @@ def hash_combine(datas: Sequence) -> jnp.ndarray:
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.uint64)
         elif np.dtype(x.dtype).kind == "f":
-            x = jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.uint64)
+            x = _f64_hash_word(_canon_float(x.astype(jnp.float64)))
         else:
             x = x.astype(jnp.int64).astype(jnp.uint64)
         h = _mix64(h ^ (x + jnp.uint64(0x9E3779B97F4A7C15)))
@@ -443,10 +511,12 @@ def probe_join_table(
         return np.empty(0, np.int64), np.empty(0, np.int64)
     probe_id, build_id = _expand_fn(bucket(total))(lo, counts, table.perm)
     probe_id, build_id = probe_id[:total], build_id[:total]
-    # exact verification (hash candidates -> equality on every key column)
+    # exact verification (hash candidates -> equality on every key column);
+    # float equality mirrors the grouping semantics: NaN matches NaN
     ok = jnp.ones((total,), jnp.bool_)
     for (pd, pv), bd in zip(probe_keys, table.key_datas):
-        ok = ok & (jnp.asarray(pd)[probe_id] == bd[build_id])
+        p, b = jnp.asarray(pd)[probe_id], bd[build_id]
+        ok = ok & ~_neq(p, b)
     keep = np.asarray(ok)
     return np.asarray(probe_id)[keep], np.asarray(build_id)[keep]
 

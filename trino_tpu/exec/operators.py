@@ -627,7 +627,14 @@ class SemiJoinOperator(Operator):
             keys.append(_probe_key_tuple(c, bdict))
             if c.valid is not None:
                 null_probe |= ~np.asarray(c.valid)
-        pi, bi = K.probe_join_table(self.bridge.table, keys)
+        if not self.source_keys:
+            # EXISTS with only non-equi residuals decorrelates to a keyless
+            # semi-join: every probe row pairs with every build row and the
+            # residual alone decides the mark (cross-join fallback, same as
+            # LookupJoinOperator).
+            pi, bi = K.probe_join_table(self.bridge.table, batch.num_rows)
+        else:
+            pi, bi = K.probe_join_table(self.bridge.table, keys)
         if self.residual is not None and len(pi):
             pair_cols = [c.take(pi) for c in batch.columns] + [
                 c.take(bi) for c in self.bridge.batch.columns]

@@ -156,4 +156,10 @@ class DistributedQueryRunner:
             run_pipelines(local.pipelines)
         except BaseException as e:  # noqa: BLE001 — surfaced to coordinator
             errors.append(e)
-            stage.buffers[task_index].set_finished()
+            # unblock every sibling immediately: producers stuck in enqueue
+            # backpressure and consumers polling this (now dead) task would
+            # otherwise wait out the full join timeout before the real error
+            # surfaces
+            for s in stages.values():
+                for b in s.buffers:
+                    b.abort()

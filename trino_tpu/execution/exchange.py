@@ -84,7 +84,9 @@ class OutputBuffer:
                 stream = self._pages[partition]
             pages = [b for b in stream if b is not None]
             next_token = acked + len(stream)
-            done = self._finished and not stream
+            # an aborted buffer reports done so consumers unwind instead of
+            # polling a dead producer forever
+            done = (self._finished and not stream) or self._aborted
             return pages, next_token, done
 
 

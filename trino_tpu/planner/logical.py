@@ -655,12 +655,19 @@ class LogicalPlanner:
                 Filter(inner.node.output_names, inner.node.output_types,
                        inner.node, _conjoin(inner_filters)), inner.qualifiers)
         group_irs = [b for (_, b) in corr_pairs]
+        # zero-row marker: count(*) is non-NULL for every real group, so the
+        # LEFT join null-extends it to NULL exactly when an outer row matched
+        # zero inner rows — lets us restore each aggregate's zero-row value
+        # for ANY select expression (Trino: TransformCorrelatedScalarAggregation
+        # aggregates over the null-extended join for the same effect).
+        mark_idx = collector.add("count", None, False, BIGINT)
         agg_rel, rewrite = self._plan_aggregation(inner, group_irs, collector, None)
         value_ir = rewrite_expr(sel_ir, rewrite)
         nkeys = len(group_irs)
+        mark_ch = nkeys + mark_idx
         value_rel = agg_rel.append([value_ir], ["_scalar_value"])
-        # prune to keys + value
-        keep = list(range(nkeys)) + [value_rel.width - 1]
+        # prune to keys + value + marker
+        keep = list(range(nkeys)) + [value_rel.width - 1, mark_ch]
         proj = Project(
             tuple(value_rel.node.output_names[i] for i in keep),
             tuple(value_rel.node.output_types[i] for i in keep),
@@ -674,21 +681,26 @@ class LogicalPlanner:
         types = tuple(src.node.output_types) + proj.output_types
         jn = Join(names, types, src.node, proj, "LEFT",
                   tuple(och), tuple(range(nkeys)), None)
-        new_rel = RelationPlan(jn, src.qualifiers + [None] * (nkeys + 1))
-        ir: RowExpression = InputRef(types[-1], new_rel.width - 1)
-        # count over zero inner rows is 0, not NULL: the LEFT join null-
-        # extends missing groups, so coalesce back the value the expression
-        # takes at count=0 (Trino: TransformCorrelatedScalarAggregation's
-        # default-value projection).  Only count-family aggregates have a
-        # non-NULL zero-row value; sum/min/max are NULL over no rows, which
-        # the null-extension already produces.
+        new_rel = RelationPlan(jn, src.qualifiers + [None] * (nkeys + 2))
+        value_ref: RowExpression = InputRef(types[-2], new_rel.width - 2)
+        mark_ref = InputRef(BIGINT, new_rel.width - 1)
+        # Restore the select expression's zero-row value: substitute every
+        # aggref with its value over zero rows (count -> 0, everything else ->
+        # NULL) and switch on the marker, so e.g. coalesce(sum(x), 0) yields 0
+        # (not NULL) for outer rows with no matches while a genuine NULL value
+        # on a matched group (all-NULL sum) is preserved.
         aggrefs = [x for x in walk(sel_ir)
                    if isinstance(x, Call) and x.name == "$aggref"]
-        if aggrefs and all(collector.calls[a.args[0].value][0] == "count"
-                           for a in aggrefs):
-            subst = {a: Literal(a.type, 0) for a in aggrefs}
-            default_expr = rewrite_expr(sel_ir, subst)
-            ir = Call(ir.type, "$coalesce", (ir, default_expr))
+        subst: dict[RowExpression, RowExpression] = {}
+        for a in aggrefs:
+            fn = collector.calls[a.args[0].value][0]
+            subst[a] = Literal(a.type, 0 if fn == "count" else None)
+        default_expr = rewrite_expr(sel_ir, subst)
+        if default_expr == Literal(value_ref.type, None):
+            return new_rel, value_ref
+        ir: RowExpression = Call(
+            value_ref.type, "$if",
+            (Call(BOOLEAN, "$is_null", (mark_ref,)), default_expr, value_ref))
         return new_rel, ir
 
 

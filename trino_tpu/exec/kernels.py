@@ -76,11 +76,14 @@ def _neq(a, b):
 
 
 @lru_cache(maxsize=None)
-def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...]):
+def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...], has_live: bool):
+    n_valid = sum(has_valid)
+
     @jax.jit
     def fn(*flat):
         datas = list(flat[:num_keys])
-        valids = list(flat[num_keys:])
+        valids = list(flat[num_keys:num_keys + n_valid])
+        live = flat[num_keys + n_valid] if has_live else None
         # normalize: NULL lanes carry arbitrary fill (e.g. div-by-zero output);
         # zero them so every NULL is bit-identical and sorts into one run
         vmap = {}
@@ -96,12 +99,15 @@ def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...]):
                 vi += 1
                 datas[i] = jnp.where(v, datas[i], jnp.zeros((), datas[i].dtype))
                 vmap[i] = v
-        # lexsort: last key in the tuple is the primary sort key
+        # lexsort: last key in the tuple is the primary sort key; dead rows
+        # (selection-mask filtering) sort after every live row
         sort_keys = []
         for i in reversed(range(num_keys)):
             sort_keys.append(datas[i])
             if i in vmap:
                 sort_keys.append(vmap[i])
+        if live is not None:
+            sort_keys.append(~live)
         perm = jnp.lexsort(tuple(sort_keys))
         new_group = jnp.zeros(datas[0].shape, dtype=jnp.bool_)
         for i in range(num_keys):
@@ -113,23 +119,35 @@ def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...]):
                     [jnp.ones((1,), jnp.bool_), v[1:] != v[:-1]]
                 )
             new_group = new_group | diff
+        if live is not None:
+            lv = live[perm]
+            # force a boundary at the live->dead transition so dead rows can
+            # never extend the last live group, and count live groups only;
+            # dead rows get gids >= num_groups and fall out of every scatter
+            new_group = new_group | jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), lv[1:] != lv[:-1]])
+            gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+            return perm, gid, jnp.sum(new_group & lv)
         gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
         return perm, gid, gid[-1] + 1
 
     return fn
 
 
-def group_ids(keys: Sequence[tuple]) -> tuple[np.ndarray, np.ndarray, int]:
-    """keys: [(data, valid|None), ...] equal-length 1-D arrays.
+def group_ids(keys: Sequence[tuple], live=None) -> tuple:
+    """keys: [(data, valid|None), ...] equal-length 1-D arrays; ``live`` an
+    optional row mask (False = dead padded/filtered row).
 
     Returns (perm, gid, num_groups): ``perm`` sorts rows so equal keys are
-    adjacent; ``gid[i]`` is the dense group id of sorted row i.
-    """
+    adjacent (dead rows last); ``gid[i]`` is the dense group id of sorted row
+    i; dead rows receive gids >= num_groups.  perm/gid stay on device."""
     num_keys = len(keys)
     has_valid = tuple(v is not None for _, v in keys)
     datas = [jnp.asarray(d) for d, _ in keys]
     valids = [jnp.asarray(v) for _, v in keys if v is not None]
-    perm, gid, n = _group_ids_fn(num_keys, has_valid)(*datas, *valids)
+    extra = [jnp.asarray(live)] if live is not None else []
+    perm, gid, n = _group_ids_fn(num_keys, has_valid, live is not None)(
+        *datas, *valids, *extra)
     return perm, gid, int(n)
 
 
@@ -166,7 +184,11 @@ def _reduce_fn(spec: tuple, cap: int):
         for fname, has_valid, dtype_str, distinct in spec:
             dtype = jnp.dtype(dtype_str)
             if fname == "count_star":
-                outs.append((jax.ops.segment_sum(ones, gid, cap), None))
+                c = ones
+                if has_valid:  # the live mask of a padded batch
+                    c = flat[i][perm].astype(jnp.int64)
+                    i += 1
+                outs.append((jax.ops.segment_sum(c, gid, cap), None))
                 continue
             data = flat[i][perm]
             i += 1
@@ -260,7 +282,9 @@ def grouped_reduce(
     flat = []
     for fn, data, valid, dtype, distinct in aggs:
         if fn == "count_star" or data is None:
-            spec.append(("count_star", False, "int64", False))
+            spec.append(("count_star", valid is not None, "int64", False))
+            if valid is not None:  # live mask: count only live rows
+                flat.append(jnp.asarray(valid))
             continue
         spec.append((fn, valid is not None, np.dtype(dtype).str, bool(distinct)))
         flat.append(jnp.asarray(data))
@@ -269,24 +293,27 @@ def grouped_reduce(
     outs = _reduce_fn(tuple(spec), cap)(jnp.asarray(perm), jnp.asarray(gid), *flat)
     result = []
     for data, valid in outs:
-        d = np.asarray(data)[:num_groups]
-        v = None if valid is None else np.asarray(valid)[:num_groups]
+        d = data[:num_groups]
+        v = None if valid is None else valid[:num_groups]
         result.append((d, v))
     return result
 
 
 def group_keys_out(perm, gid, num_groups: int, keys: Sequence[tuple]):
-    """Materialize one representative key row per group."""
+    """Materialize one representative key row per group (device arrays out;
+    dead rows carry gids >= cap-scatter range and are dropped)."""
     cap = bucket(num_groups)
     out = []
     gid_j = jnp.asarray(gid)
     perm_j = jnp.asarray(perm)
     for data, valid in keys:
-        d = jnp.zeros((cap,), jnp.asarray(data).dtype).at[gid_j].set(jnp.asarray(data)[perm_j])
-        out_d = np.asarray(d)[:num_groups]
+        d = jnp.zeros((cap,), jnp.asarray(data).dtype).at[gid_j].set(
+            jnp.asarray(data)[perm_j], mode="drop")
+        out_d = d[:num_groups]
         if valid is not None:
-            v = jnp.zeros((cap,), jnp.bool_).at[gid_j].max(jnp.asarray(valid)[perm_j])
-            out.append((out_d, np.asarray(v)[:num_groups]))
+            v = jnp.zeros((cap,), jnp.bool_).at[gid_j].max(
+                jnp.asarray(valid)[perm_j], mode="drop")
+            out.append((out_d, v[:num_groups]))
         else:
             out.append((out_d, None))
     return out
@@ -475,10 +502,11 @@ def _expand_fn(cap: int):
 
 
 def probe_join_table(
-    table: JoinTable, probe_keys: Sequence[tuple]
+    table: JoinTable, probe_keys: Sequence[tuple], live=None
 ) -> tuple[np.ndarray, np.ndarray]:
     """Returns (probe_idx, build_idx) pairs of ALL equi-matches, exactly
-    verified.  Caller layers inner/left/semi semantics on top.
+    verified.  Caller layers inner/left/semi semantics on top.  ``live``
+    masks padded/filtered-out probe rows (they never match).
 
     ``n_probe`` must be passed for the keyless (cross-join) table."""
     if not table.key_datas:  # cross join
@@ -503,6 +531,8 @@ def probe_join_table(
     lo, counts = _probe_ranges_fn()(table.sorted_hash, ph)
     if pnull is not None:
         counts = jnp.where(pnull, 0, counts)
+    if live is not None:
+        counts = jnp.where(jnp.asarray(live), counts, 0)
     if table.has_null_key:
         # sentinel region must never match
         counts = jnp.where(ph == jnp.uint64(0xFFFFFFFFFFFFFFFF), 0, counts)

@@ -22,10 +22,12 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops.expr import compile_expression
-from ..spi.batch import Column, ColumnBatch
+from ..spi.batch import Column, ColumnBatch, pad_to_bucket, unify_dictionaries
 from ..spi.connector import Connector, ConnectorPageSink, Split
 from ..spi.types import BIGINT, BOOLEAN, DOUBLE, DecimalType, Type, is_string
 from ..sql.ir import RowExpression
@@ -113,7 +115,9 @@ class ScanOperator(Operator):
                 continue
             batch = self._source.get_next_batch()
             if batch is not None:
-                return batch
+                # bucket scan output shapes so every downstream jitted
+                # program compiles once per (pipeline, bucket)
+                return pad_to_bucket(batch)
 
     def is_finished(self) -> bool:
         return self._closed or (self._source is None and not self.splits)
@@ -140,14 +144,17 @@ class ValuesOperator(Operator):
 
 
 def _to_cols(batch: ColumnBatch):
-    return [(np.asarray(c.data), None if c.valid is None else np.asarray(c.valid))
-            for c in batch.columns]
+    """(data, valid) pairs, device-passthrough: jax arrays stay on device."""
+    return [(c.data, c.valid) for c in batch.columns]
 
 
 class FilterProjectOperator(Operator):
-    """Fused filter+project; the whole expression tree evaluates as one
-    traced program so XLA fuses it with neighbouring kernels (replaces
-    sql/gen/PageFunctionCompiler.java:104 bytecode)."""
+    """Fused filter+project compiled to ONE jitted XLA program per
+    (expression set, shape bucket): the predicate ANDs into the batch's
+    ``live`` selection mask instead of compacting (dynamic shapes defeat
+    XLA), projections evaluate on every lane, and columns stay device-
+    resident between operators.  Replaces sql/gen/PageFunctionCompiler.java:
+    104 bytecode + operator/ScanFilterAndProjectOperator.java:68 fusion."""
 
     def __init__(self, predicate: Optional[RowExpression],
                  projections: Optional[Sequence[RowExpression]],
@@ -177,7 +184,33 @@ class FilterProjectOperator(Operator):
             if self.projections is not None
             else None
         )
-        self._compiled = (pred, projs)
+        out_dtypes = [t.storage_dtype for t in self.output_types]
+
+        def run(cols, live):
+            n = cols[0][0].shape[0]
+            if pred is not None:
+                data, valid = pred(cols)
+                mask = data if valid is None else data & valid
+                if getattr(mask, "ndim", 1) == 0:
+                    mask = jnp.broadcast_to(mask, (n,))
+                live = mask if live is None else live & mask
+            if projs is None:
+                return [(d, v) for d, v in cols], live
+            outs = []
+            for ce, dt in zip(projs, out_dtypes):
+                d, v = ce(cols)
+                d = jnp.asarray(d)
+                if d.ndim == 0:
+                    d = jnp.broadcast_to(d, (n,))
+                d = d.astype(dt)
+                if v is not None:
+                    v = jnp.asarray(v)
+                    if v.ndim == 0:
+                        v = jnp.broadcast_to(v, (n,))
+                outs.append((d, v))
+            return outs, live
+
+        self._compiled = (jax.jit(run), projs)
         self._compiled_dicts = dicts
         return self._compiled
 
@@ -185,37 +218,19 @@ class FilterProjectOperator(Operator):
         return self._pending is None and super().needs_input()
 
     def add_input(self, batch: ColumnBatch) -> None:
-        pred, projs = self._compile(batch)
-        cols = _to_cols(batch)
-        if pred is not None:
-            data, valid = pred(cols)
-            mask = np.asarray(data)
-            if valid is not None:
-                mask = mask & np.asarray(valid)
-            if mask.ndim == 0:
-                mask = np.broadcast_to(mask, (batch.num_rows,))
-            batch = batch.filter(mask)
-            if batch.num_rows == 0:
-                return
-            cols = _to_cols(batch)
-        if projs is None:
+        if batch.num_columns == 0:
             self._pending = batch.rename(self.output_names)
             return
-        out = []
-        n = batch.num_rows
-        for ce, t in zip(projs, self.output_types):
-            data, valid = ce(cols)
-            d = np.asarray(data)
-            if d.ndim == 0:
-                d = np.broadcast_to(d, (n,)).copy()
-            v = None
-            if valid is not None:
-                v = np.asarray(valid)
-                if v.ndim == 0:
-                    v = np.broadcast_to(v, (n,)).copy()
-            out.append(Column(t, d.astype(t.storage_dtype, copy=False), v,
-                              ce.dictionary))
-        self._pending = ColumnBatch(self.output_names, out)
+        batch = pad_to_bucket(batch)
+        run, projs = self._compile(batch)
+        outs, live = run(_to_cols(batch), batch.live)
+        if projs is None:
+            cols = [Column(c.type, d, v, c.dictionary)
+                    for (d, v), c in zip(outs, batch.columns)]
+        else:
+            cols = [Column(t, d, v, ce.dictionary)
+                    for (d, v), t, ce in zip(outs, self.output_types, projs)]
+        self._pending = ColumnBatch(self.output_names, cols, live)
 
     def get_output(self) -> Optional[ColumnBatch]:
         b, self._pending = self._pending, None
@@ -253,6 +268,49 @@ def _round_half_up_div_int(s: np.ndarray, c: np.ndarray) -> np.ndarray:
     return np.where(s < 0, -q, q)
 
 
+def _concat_device(batches: Sequence[ColumnBatch]) -> ColumnBatch:
+    """Concatenate (possibly masked) batches on device, padded to the
+    total's power-of-two bucket.  Dead/padding rows are carried in ``live``
+    so the result has a cache-friendly static shape — this is how blocking
+    operators materialize input without leaving the device."""
+    names = batches[0].names
+    total = sum(b.num_rows for b in batches)
+    cap = K.bucket(total)
+    pad = cap - total
+    any_live = pad > 0 or any(b.live is not None for b in batches)
+    out_cols = []
+    for i in range(len(names)):
+        cs = [b.columns[i] for b in batches]
+        if cs[0].type.is_dictionary_encoded:
+            cs = unify_dictionaries(cs)
+        parts = [jnp.asarray(c.data) for c in cs]
+        if pad:
+            parts.append(jnp.zeros(pad, parts[0].dtype))
+        data = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        valid = None
+        if any(c.valid is not None for c in cs):
+            vparts = [
+                jnp.asarray(c.valid) if c.valid is not None
+                else jnp.ones(c.data.shape[0], jnp.bool_)
+                for c in cs
+            ]
+            if pad:
+                vparts.append(jnp.zeros(pad, jnp.bool_))
+            valid = jnp.concatenate(vparts) if len(vparts) > 1 else vparts[0]
+        out_cols.append(Column(cs[0].type, data, valid, cs[0].dictionary))
+    live = None
+    if any_live:
+        lparts = [
+            jnp.asarray(b.live) if b.live is not None
+            else jnp.ones(b.num_rows, jnp.bool_)
+            for b in batches
+        ]
+        if pad:
+            lparts.append(jnp.zeros(pad, jnp.bool_))
+        live = jnp.concatenate(lparts) if len(lparts) > 1 else lparts[0]
+    return ColumnBatch(names, out_cols, live)
+
+
 class HashAggregationOperator(Operator):
     """Grouped aggregation: accumulate batches, then sort-based segment
     reduction (replaces operator/HashAggregationOperator.java:53 +
@@ -279,8 +337,7 @@ class HashAggregationOperator(Operator):
         if a.fn == "count" and a.arg < 0:
             return ("count_star", None, None, np.int64, False)
         col = inp.columns[a.arg]
-        data = np.asarray(col.data)
-        valid = None if col.valid is None else np.asarray(col.valid)
+        data, valid = col.data, col.valid
         if a.fn == "avg":
             # decomposes into sum+count; dtype promotes to f64 on device
             return ("avg", data, valid, np.float64, a.distinct)
@@ -320,24 +377,33 @@ class HashAggregationOperator(Operator):
         return ColumnBatch(self.output_names, cols)
 
     def _compute(self) -> ColumnBatch:
-        inp = ColumnBatch.concat(self._batches) if self._batches else None
-        n = inp.num_rows if inp is not None else 0
         nk = len(self.group_keys)
-        if n == 0:
+        if not self._batches:
             return self._empty_result(nk)
+        inp = _concat_device(self._batches)
+        live = inp.live  # None = all rows real
+        n = inp.num_rows
 
         if nk:
             key_cols = [inp.columns[i] for i in self.group_keys]
-            keys = [(np.asarray(c.data),
-                     None if c.valid is None else np.asarray(c.valid))
-                    for c in key_cols]
-            perm, gid, num_groups = K.group_ids(keys)
+            keys = [(c.data, c.valid) for c in key_cols]
+            perm, gid, num_groups = K.group_ids(keys, live)
+            if num_groups == 0:  # every row dead (fully filtered input)
+                return self._empty_result(nk)
             keys_out = K.group_keys_out(perm, gid, num_groups, keys)
         else:
             key_cols, keys_out = [], []
-            perm = np.arange(n)
-            gid = np.zeros(n, np.int32)
+            perm = jnp.arange(n)
+            gid = jnp.zeros(n, jnp.int32)
             num_groups = 1
+
+        def fold_live(valid):
+            """Dead rows never contribute: fold ``live`` into validity."""
+            if live is None:
+                return valid
+            if valid is None:
+                return live
+            return jnp.asarray(valid) & jnp.asarray(live)
 
         # kernel specs; avg expands to (sum, count) state pairs.  FINAL
         # merges partial states: count -> sum of counts, others same fn.
@@ -345,19 +411,19 @@ class HashAggregationOperator(Operator):
         for idx, a in enumerate(self.aggs):
             if self.step == "FINAL":
                 c = inp.columns[a.arg]
-                data = np.asarray(c.data)
-                valid = None if c.valid is None else np.asarray(c.valid)
+                data, valid = c.data, fold_live(c.valid)
                 if a.fn == "avg":
                     avg_slots[idx] = len(specs)
                     c2 = inp.columns[a.arg + 1]
                     specs.append(("sum", data, valid, np.float64, False))
-                    specs.append(("sum", np.asarray(c2.data), None, np.int64, False))
+                    specs.append(("sum", c2.data, fold_live(None), np.int64, False))
                 elif a.fn in ("count", "count_star"):
-                    specs.append(("sum", data, None, np.int64, False))
+                    specs.append(("sum", data, fold_live(None), np.int64, False))
                 else:
                     specs.append((a.fn, data, valid, data.dtype, False))
                 continue
             s = self._agg_spec(a, inp, a.type)
+            s = (s[0], s[1], fold_live(s[2]), s[3], s[4])
             if s[0] == "avg":
                 avg_slots[idx] = len(specs)
                 scale = 0
@@ -382,33 +448,27 @@ class HashAggregationOperator(Operator):
                 ri += 2
                 if self.step == "PARTIAL":
                     # emit mergeable states: scale-free sum + count
-                    sv = None if (s_valid is None or s_valid.all()) else s_valid
-                    out_cols.append(Column(t, s_data.astype(np.float64), sv))
+                    out_cols.append(Column(t, s_data.astype(np.float64), s_valid))
                     out_cols.append(Column(self.output_types[len(out_cols)],
                                            c_data.astype(np.int64)))
                     continue
-                cnt = np.maximum(c_data, 1)
-                vals = s_data / cnt
-                valid = (c_data > 0)
+                cnt = jnp.maximum(jnp.asarray(c_data), 1)
+                vals = jnp.asarray(s_data) / cnt
+                valid = jnp.asarray(c_data) > 0
                 if s_valid is not None:
-                    valid = valid & s_valid
-                valid = None if valid.all() else valid
+                    valid = valid & jnp.asarray(s_valid)
                 out_cols.append(Column(t, vals.astype(t.storage_dtype), valid))
                 continue
             d, v = reduced[ri]
             ri += 1
-            if a.fn in ("sum", "min", "max", "any_value"):
-                if v is not None:
-                    v = None if v.all() else v
-            else:
+            if a.fn not in ("sum", "min", "max", "any_value"):
                 v = None  # count never NULL
             dict_ = None
             if self.step != "FINAL" and a.arg >= 0:
                 dict_ = inp.columns[a.arg].dictionary
             elif self.step == "FINAL" and a.fn in ("min", "max", "any_value"):
                 dict_ = inp.columns[a.arg].dictionary
-            out_cols.append(Column(t, d.astype(t.storage_dtype, copy=False), v,
-                                   dict_))
+            out_cols.append(Column(t, d.astype(t.storage_dtype), v, dict_))
         return ColumnBatch(self.output_names, out_cols)
 
     def get_output(self) -> Optional[ColumnBatch]:
@@ -442,20 +502,22 @@ class JoinBridge:
 def _probe_key_tuple(col: Column, build_dict: Optional[np.ndarray]):
     """(data, valid) for a probe key, remapping dictionary codes into the
     build side's code space when the two sides carry different dictionaries
-    (string equi-join correctness: code i means different strings per dict)."""
-    data = np.asarray(col.data)
-    valid = None if col.valid is None else np.asarray(col.valid)
+    (string equi-join correctness: code i means different strings per dict).
+    The remap table is computed host-side over the (small) dictionaries; the
+    code gather stays on device when the column is device-resident."""
+    data, valid = col.data, col.valid
     pdict = col.dictionary
     if pdict is not None or build_dict is not None:
         if build_dict is None or len(build_dict) == 0:
             # build side has no dictionary: nothing can match by value
-            return np.full(len(data), -1, np.int64), valid
+            return np.full(len(col), -1, np.int64), valid
         if pdict is not None and pdict is not build_dict:
             pos = np.searchsorted(build_dict, pdict)
             clipped = np.clip(pos, 0, len(build_dict) - 1)
             ok = build_dict[clipped] == pdict
             remap = np.where(ok, clipped, -1).astype(np.int64)
-            data = remap[data]
+            data = (remap[data] if isinstance(data, np.ndarray)
+                    else jnp.asarray(remap)[data])
     return data, valid
 
 
@@ -526,13 +588,14 @@ class LookupJoinOperator(Operator):
     def add_input(self, probe: ColumnBatch) -> None:
         build = self.bridge.batch
         if not self.left_keys:  # cross join (nested-loop fallback)
+            probe = probe.compact()
             pi, bi = K.probe_join_table(self.bridge.table, probe.num_rows)
         else:
             keys = [
                 _probe_key_tuple(probe.columns[ch], self.bridge.key_dicts[k])
                 for k, ch in enumerate(self.left_keys)
             ]
-            pi, bi = K.probe_join_table(self.bridge.table, keys)
+            pi, bi = K.probe_join_table(self.bridge.table, keys, probe.live)
         if self.join_type == "SINGLE" and len(pi):
             # scalar subquery: any probe row with >1 match is a cardinality
             # violation (Trino: EnforceSingleRowNode -> "Scalar sub-query
@@ -556,7 +619,9 @@ class LookupJoinOperator(Operator):
         if self.join_type in ("LEFT", "SINGLE"):
             matched = np.zeros(probe.num_rows, bool)
             matched[pi] = True
-            un = np.nonzero(~matched)[0]
+            alive = (np.ones(probe.num_rows, bool) if probe.live is None
+                     else np.asarray(probe.live))
+            un = np.nonzero(alive & ~matched)[0]
             if len(un):
                 left_cols = [c.take(un) for c in probe.columns]
                 right_cols = _null_columns(build, len(un))
@@ -619,6 +684,12 @@ class SemiJoinOperator(Operator):
         return self.bridge.ready and self._pending is None and super().needs_input()
 
     def add_input(self, batch: ColumnBatch) -> None:
+        if not self.source_keys:
+            # EXISTS with only non-equi residuals decorrelates to a keyless
+            # semi-join: every probe row pairs with every build row and the
+            # residual alone decides the mark (cross-join fallback, same as
+            # LookupJoinOperator).
+            batch = batch.compact()
         keys = []
         null_probe = np.zeros(batch.num_rows, bool)
         for k, ch in enumerate(self.source_keys):
@@ -628,13 +699,9 @@ class SemiJoinOperator(Operator):
             if c.valid is not None:
                 null_probe |= ~np.asarray(c.valid)
         if not self.source_keys:
-            # EXISTS with only non-equi residuals decorrelates to a keyless
-            # semi-join: every probe row pairs with every build row and the
-            # residual alone decides the mark (cross-join fallback, same as
-            # LookupJoinOperator).
             pi, bi = K.probe_join_table(self.bridge.table, batch.num_rows)
         else:
-            pi, bi = K.probe_join_table(self.bridge.table, keys)
+            pi, bi = K.probe_join_table(self.bridge.table, keys, batch.live)
         if self.residual is not None and len(pi):
             pair_cols = [c.take(pi) for c in batch.columns] + [
                 c.take(bi) for c in self.bridge.batch.columns]
@@ -659,7 +726,7 @@ class SemiJoinOperator(Operator):
                 valid = ~unknown
         mark = Column(BOOLEAN, matched, valid)
         self._pending = ColumnBatch(
-            self.output_names, list(batch.columns) + [mark])
+            self.output_names, list(batch.columns) + [mark], batch.live)
 
     def get_output(self) -> Optional[ColumnBatch]:
         b, self._pending = self._pending, None
@@ -737,6 +804,7 @@ class LimitOperator(Operator):
         return self._remaining > 0 and self._pending is None and super().needs_input()
 
     def add_input(self, batch: ColumnBatch) -> None:
+        batch = batch.compact()
         if batch.num_rows > self._remaining:
             batch = batch.slice(0, self._remaining)
         self._remaining -= batch.num_rows
@@ -806,6 +874,7 @@ class TableWriterOperator(Operator):
         self._emitted = False
 
     def add_input(self, batch: ColumnBatch) -> None:
+        batch = batch.compact()
         self._rows += batch.num_rows
         self.sink.append(batch)
 

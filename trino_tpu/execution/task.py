@@ -78,6 +78,10 @@ class PartitionedOutputSink(Operator):
         self.keys = list(keys)
 
     def add_input(self, batch: ColumnBatch) -> None:
+        # the exchange is a host/network boundary: densify device batches
+        batch = batch.compact()
+        if batch.num_rows == 0:
+            return
         n = self.buffer.num_partitions
         if self.kind == "REPARTITION" and n > 1:
             cols = [batch.columns[k] for k in self.keys]

@@ -40,7 +40,18 @@ from .types import (
     days_to_date,
 )
 
-__all__ = ["Column", "ColumnBatch", "encode_strings", "unify_dictionaries"]
+__all__ = ["Column", "ColumnBatch", "encode_strings", "unify_dictionaries",
+           "round_up_pow2", "pad_to_bucket"]
+
+
+def round_up_pow2(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two — the static-shape recompile bucket.  All
+    batch shapes in the jitted data plane are bucketed so XLA programs are
+    compiled once per (pipeline, bucket) instead of once per row count."""
+    c = minimum
+    while c < n:
+        c <<= 1
+    return c
 
 
 def encode_strings(values: Sequence[str | None]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -53,7 +64,13 @@ def encode_strings(values: Sequence[str | None]) -> tuple[np.ndarray, np.ndarray
 
 @dataclass
 class Column:
-    """One column of a batch: fixed-width array + validity + dictionary."""
+    """One column of a batch: fixed-width array + validity + dictionary.
+
+    ``data``/``valid`` may be numpy (host) OR jax arrays (device-resident):
+    the engine's hot path keeps columns on device between operators and only
+    materializes to host at true boundaries (exchange serialization, client
+    results, oracle diffs).  Mirrors how the reference keeps Pages inside the
+    JVM heap between compiled operators (operator/Driver.java:403-408)."""
 
     type: Type
     data: np.ndarray
@@ -61,7 +78,9 @@ class Column:
     dictionary: np.ndarray | None = None  # sorted host-side values (strings)
 
     def __post_init__(self):
-        if self.valid is not None and self.valid.all():
+        # normalizing all-valid masks to None requires a host sync for device
+        # arrays — only do it for numpy
+        if isinstance(self.valid, np.ndarray) and self.valid.all():
             self.valid = None
 
     def __len__(self) -> int:
@@ -69,9 +88,9 @@ class Column:
 
     @property
     def nbytes(self) -> int:
-        n = int(np.asarray(self.data).nbytes)
+        n = int(self.data.nbytes)
         if self.valid is not None:
-            n += int(np.asarray(self.valid).nbytes)
+            n += int(self.valid.nbytes)
         return n
 
     def valid_mask(self) -> np.ndarray:
@@ -100,10 +119,13 @@ class Column:
         return Column(type_, data, valid)
 
     def take(self, indices: np.ndarray) -> "Column":
-        valid = None if self.valid is None else np.asarray(self.valid)[indices]
-        return Column(self.type, np.asarray(self.data)[indices], valid, self.dictionary)
+        # works for numpy and jax alike (jax arrays gather on device)
+        valid = None if self.valid is None else self.valid[indices]
+        return Column(self.type, self.data[indices], valid, self.dictionary)
 
     def filter(self, mask: np.ndarray) -> "Column":
+        # boolean-mask compaction is inherently dynamic-shape: force host
+        mask = np.asarray(mask)
         valid = None if self.valid is None else np.asarray(self.valid)[mask]
         return Column(self.type, np.asarray(self.data)[mask], valid, self.dictionary)
 
@@ -183,17 +205,32 @@ def unify_dictionaries(columns: Sequence[Column]) -> list[Column]:
     for c, d in zip(columns, dicts):
         remap = np.searchsorted(merged, d).astype(np.int32)
         # no source dictionary => codes are meaningless; point at slot 0
-        data = remap[np.asarray(c.data)] if len(d) else np.zeros(len(c), dtype=np.int32)
+        if not len(d):
+            data = np.zeros(len(c), dtype=np.int32)
+        elif isinstance(c.data, np.ndarray):
+            data = remap[c.data]
+        else:  # device codes: gather the (tiny) remap table on device
+            import jax.numpy as jnp
+
+            data = jnp.asarray(remap)[c.data]
         out.append(Column(c.type, data, c.valid, merged))
     return out
 
 
 @dataclass
 class ColumnBatch:
-    """An ordered, named set of equal-length columns (the Page equivalent)."""
+    """An ordered, named set of equal-length columns (the Page equivalent).
+
+    ``live`` is an optional per-row mask (True = row exists): the fused
+    filter kernels mark rows dead instead of compacting, because compaction
+    is a dynamic-shape operation XLA cannot fuse — batches stay at their
+    padded power-of-two size through the jitted pipeline (the selection-
+    vector idiom replacing Trino's Page.getPositions compaction).  Operators
+    either understand ``live`` or call :meth:`compact` first."""
 
     names: list[str]
     columns: list[Column]
+    live: np.ndarray | None = None  # None = every row live
 
     def __post_init__(self):
         assert len(self.names) == len(self.columns)
@@ -203,7 +240,24 @@ class ColumnBatch:
 
     @property
     def num_rows(self) -> int:
+        """Physical row slots (including dead rows when ``live`` is set)."""
         return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def live_count(self) -> int:
+        """Number of live rows (host sync when ``live`` is a device array)."""
+        if self.live is None:
+            return self.num_rows
+        return int(np.asarray(self.live).sum())
+
+    def compact(self) -> "ColumnBatch":
+        """Densify: drop dead rows, return a host-side batch without live."""
+        if self.live is None:
+            return self
+        mask = np.asarray(self.live)
+        if mask.all():
+            return ColumnBatch(self.names, self.columns)
+        return ColumnBatch(self.names, [c.filter(mask) for c in self.columns])
 
     @property
     def num_columns(self) -> int:
@@ -227,15 +281,18 @@ class ColumnBatch:
         return ColumnBatch(names, cols)
 
     def take(self, indices: np.ndarray) -> "ColumnBatch":
+        assert self.live is None, "take() on a masked batch (compact first)"
         return ColumnBatch(self.names, [c.take(indices) for c in self.columns])
 
     def filter(self, mask: np.ndarray) -> "ColumnBatch":
+        assert self.live is None, "filter() on a masked batch (compact first)"
         return ColumnBatch(self.names, [c.filter(mask) for c in self.columns])
 
     def select(self, names: Sequence[str]) -> "ColumnBatch":
-        return ColumnBatch(list(names), [self.column(n) for n in names])
+        return ColumnBatch(list(names), [self.column(n) for n in names], self.live)
 
     def slice(self, start: int, stop: int) -> "ColumnBatch":
+        assert self.live is None, "slice() on a masked batch (compact first)"
         return ColumnBatch(
             self.names,
             [Column(c.type, np.asarray(c.data)[start:stop],
@@ -249,6 +306,7 @@ class ColumnBatch:
         if not batches:
             raise ValueError("ColumnBatch.concat of an empty batch list "
                              "(caller must supply at least the schema batch)")
+        batches = [b.compact() for b in batches]
         batches = [b for b in batches if b.num_rows > 0] or list(batches[:1])
         if len(batches) == 1:
             return batches[0]
@@ -268,8 +326,31 @@ class ColumnBatch:
 
     def to_pylist(self) -> list[tuple]:
         """Rows as python tuples (client/oracle boundary)."""
-        cols = [c.to_pylist() for c in self.columns]
+        dense = self.compact()
+        cols = [c.to_pylist() for c in dense.columns]
         return list(zip(*cols)) if cols else []
 
     def rename(self, names: Sequence[str]) -> "ColumnBatch":
-        return ColumnBatch(list(names), self.columns)
+        return ColumnBatch(list(names), self.columns, self.live)
+
+
+def pad_to_bucket(batch: ColumnBatch) -> ColumnBatch:
+    """Pad a dense batch to its power-of-two row bucket, marking the padding
+    dead in ``live``.  Host-side (scans produce numpy); the jitted pipeline
+    transfers the stable-shaped arrays to device once per batch."""
+    n = batch.num_rows
+    cap = round_up_pow2(n)
+    if cap == n or n == 0:
+        return batch
+    assert batch.live is None, "pad_to_bucket on an already-masked batch"
+    pad = cap - n
+    cols = []
+    for c in batch.columns:
+        data = np.asarray(c.data)
+        data = np.concatenate([data, np.zeros(pad, data.dtype)])
+        valid = None
+        if c.valid is not None:
+            valid = np.concatenate([np.asarray(c.valid), np.zeros(pad, np.bool_)])
+        cols.append(Column(c.type, data, valid, c.dictionary))
+    live = np.concatenate([np.ones(n, np.bool_), np.zeros(pad, np.bool_)])
+    return ColumnBatch(batch.names, cols, live)

@@ -55,6 +55,7 @@ class OutputBuffer:
         with self._cv:
             self._aborted = True
             self._pages = [[] for _ in range(self.num_partitions)]
+            self._bytes = 0
             self._cv.notify_all()
 
     def get(self, partition: int, token: int, timeout: float = 10.0

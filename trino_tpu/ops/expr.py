@@ -530,7 +530,7 @@ def _cast_handler(out_type, args):
         v, vv = a.fn(cols)
         ss, ds = _scale_of(src), _scale_of(out_type)
         if isinstance(out_type, DecimalType):
-            if isinstance(src, DecimalType) or np.issubdtype(np.asarray(v).dtype, np.integer):
+            if isinstance(src, DecimalType) or np.issubdtype(v.dtype, np.integer):
                 data = _decimal_rescale(v.astype(np.int64), ss, ds)
             else:  # float -> decimal
                 scaled = v * (10.0**ds)
@@ -583,7 +583,7 @@ def _round_handler(out_type, args):
                 return v, vv
             f = 10 ** (s - nd)
             return _round_half_up_div(v, f) * f, vv
-        if np.issubdtype(np.asarray(v).dtype, np.integer):
+        if np.issubdtype(v.dtype, np.integer):
             return v, vv
         f = 10.0**nd
         return jnp.round(v * f) / f, vv

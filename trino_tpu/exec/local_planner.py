@@ -39,6 +39,7 @@ from .operators import (
     TableWriterOperator,
     TopNOperator,
     ValuesOperator,
+    WindowOperator,
 )
 
 __all__ = ["LocalExecutionPlan", "LocalPlanner"]
@@ -133,6 +134,13 @@ class LocalPlanner:
             chain = self._chain(node.source)
             chain.append(SemiJoinOperator(
                 bridge, node.source_keys, node.null_aware, node.residual,
+                node.output_names, node.output_types))
+            return chain
+
+        if isinstance(node, P.Window):
+            chain = self._chain(node.source)
+            chain.append(WindowOperator(
+                node.partition_keys, node.order_keys, node.functions,
                 node.output_names, node.output_types))
             return chain
 

@@ -31,8 +31,9 @@ from ..spi.batch import Column, ColumnBatch, pad_to_bucket, unify_dictionaries
 from ..spi.connector import Connector, ConnectorPageSink, Split
 from ..spi.types import BIGINT, BOOLEAN, DOUBLE, DecimalType, Type, is_string
 from ..sql.ir import RowExpression
-from ..planner.plan import AggCall, SortKey
+from ..planner.plan import AggCall, SortKey, WindowFunc
 from . import kernels as K
+from . import window_kernels as WK
 
 __all__ = [
     "Operator",
@@ -46,6 +47,7 @@ __all__ = [
     "SemiJoinOperator",
     "SortOperator",
     "TopNOperator",
+    "WindowOperator",
     "LimitOperator",
     "DistinctLimitOperator",
     "TableWriterOperator",
@@ -734,6 +736,81 @@ class SemiJoinOperator(Operator):
 
     def is_finished(self) -> bool:
         return self.input_done and self._pending is None
+
+
+# ---------------------------------------------------------------------------
+# window
+
+
+class WindowOperator(Operator):
+    """Window-function evaluation (operator/WindowOperator.java:69): blocking
+    — accumulate, then one jitted program per (spec, shape bucket) computes
+    every function and scatters results back to input order (see
+    exec/window_kernels.py)."""
+
+    def __init__(self, partition_keys: Sequence[int],
+                 order_keys: Sequence[SortKey],
+                 functions: Sequence[WindowFunc],
+                 output_names: Sequence[str], output_types: Sequence[Type]):
+        self.partition_keys = list(partition_keys)
+        self.order_keys = list(order_keys)
+        self.functions = list(functions)
+        self.output_names = list(output_names)
+        self.output_types = list(output_types)
+        self._batches: list[ColumnBatch] = []
+        self._result: Optional[ColumnBatch] = None
+        self._emitted = False
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        if batch.num_rows:
+            self._batches.append(batch)
+
+    def finish_input(self) -> None:
+        super().finish_input()
+        if not self._batches:
+            self._result = ColumnBatch(
+                self.output_names,
+                [Column(t, np.empty(0, t.storage_dtype))
+                 for t in self.output_types])
+            return
+        inp = ColumnBatch.concat(self._batches)  # compacts + unifies dicts
+        pkeys = [(inp.columns[c].data, inp.columns[c].valid)
+                 for c in self.partition_keys]
+        okeys = [(inp.columns[k.channel].data, inp.columns[k.channel].valid,
+                  k.ascending, k.nulls_first) for k in self.order_keys]
+        specs = []
+        fn_dicts = []
+        for f in self.functions:
+            acols = [inp.columns[c] for c in f.args]
+            if len(acols) > 1 and acols[0].type.is_dictionary_encoded:
+                # lag/lead default drawn from a different dictionary column
+                acols = unify_dictionaries(acols)
+            args = [(c.data, c.valid) for c in acols]
+            fn_dicts.append(acols[0].dictionary if acols else None)
+            specs.append({
+                "fn": f.fn, "args": args, "offset": f.offset,
+                "frame": f.frame, "dtype": f.type.storage_dtype,
+            })
+        results = WK.compute_windows(pkeys, okeys, specs, inp.num_rows)
+        out_cols = list(inp.columns)
+        for f, (data, valid), fdict in zip(self.functions, results, fn_dicts):
+            dict_ = None
+            if f.args and f.fn not in ("count", "sum", "avg"):
+                dict_ = fdict
+            if f.fn in ("row_number", "rank", "dense_rank", "percent_rank",
+                        "cume_dist", "ntile", "count", "count_star"):
+                valid = None  # never NULL
+            out_cols.append(Column(f.type, data, valid, dict_))
+        self._result = ColumnBatch(self.output_names, out_cols)
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        if self._result is not None and not self._emitted:
+            self._emitted = True
+            return self._result
+        return None
+
+    def is_finished(self) -> bool:
+        return (self.input_done and self._emitted) or self._closed
 
 
 # ---------------------------------------------------------------------------

@@ -42,6 +42,7 @@ from .plan import (
     TableWriter,
     TopN,
     Values,
+    Window,
 )
 
 __all__ = ["add_exchanges", "partial_agg_layout"]
@@ -100,6 +101,17 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
                        node.source_keys, node.filter_keys, node.negated,
                        node.residual, node.null_aware)
         return _gather_if(out, single)
+
+    if isinstance(node, Window):
+        src = _visit(node.source, single=False)
+        if node.partition_keys:
+            # rows of one partition must colocate: hash-repartition on the
+            # partition keys (reference: AddExchanges window distribution)
+            src = _exchange(src, "REPARTITION", node.partition_keys)
+            out = _replace_source(node, src)
+            return _gather_if(out, single)
+        src = _exchange(src, "GATHER")
+        return _replace_source(node, src)
 
     if isinstance(node, Sort):
         src = _visit(node.source, single=False)

@@ -27,6 +27,7 @@ from ..sql.analyzer import (
     Field,
     Scope,
     Translator,
+    WindowCollector,
     agg_result_type,
     cast_to,
     rewrite_expr,
@@ -49,6 +50,8 @@ from .plan import (
     TableWriter,
     TopN,
     Values,
+    Window,
+    WindowFunc,
 )
 
 __all__ = ["LogicalPlanner", "RelationPlan"]
@@ -245,9 +248,10 @@ class LogicalPlanner:
 
         has_group = bool(spec.group_by)
         collector = AggregateCollector()
+        wcollector = WindowCollector()
         rewrite: dict[RowExpression, RowExpression] = {}
         scope = rel.scope(outer)
-        tr = Translator(scope, aggregates=collector)
+        tr = Translator(scope, aggregates=collector, windows=wcollector)
         select_items = self._expand_stars(spec, rel, star_width)
         select_irs = [tr.translate(it.expr) for it in select_items]
         having_ir = None
@@ -315,6 +319,16 @@ class LogicalPlanner:
         elif spec.having is not None:
             raise AnalysisError("HAVING requires aggregation")
 
+        # window functions: evaluated after aggregation/HAVING, before
+        # DISTINCT and ORDER BY (reference: sql/planner/QueryPlanner window
+        # planning order)
+        win_rewrite: dict[RowExpression, RowExpression] = {}
+        if wcollector.calls:
+            rel, win_rewrite = self._plan_windows(
+                rel, wcollector, rewrite,
+                require_covered=(has_group or has_aggs))
+            select_irs = [rewrite_expr(e, win_rewrite) for e in select_irs]
+
         # SELECT projection
         names = []
         for i, it in enumerate(select_items):
@@ -334,10 +348,12 @@ class LogicalPlanner:
 
         # stash context for ORDER BY expression matching
         def translate_in_select_ctx(e: ast.Expr) -> RowExpression:
-            t = Translator(scope, aggregates=collector)
+            t = Translator(scope, aggregates=collector, windows=wcollector)
             ir = t.translate(e)
             if has_group or has_aggs:
                 ir = rewrite_expr(ir, rewrite)
+            if win_rewrite:
+                ir = rewrite_expr(ir, win_rewrite)
             return ir
 
         self._last_select_ctx = (spec, translate_in_select_ctx)
@@ -400,6 +416,80 @@ class LogicalPlanner:
             placeholder = Call(out_t, "$aggref", (Literal(BIGINT, j),))
             rewrite[placeholder] = InputRef(out_t, len(key_channels) + j)
         return out, rewrite
+
+    # -------------------------------------------------------------- windows
+    def _plan_windows(self, rel: RelationPlan, wcollector: WindowCollector,
+                      agg_rewrite: dict, require_covered: bool):
+        """Emit Window nodes (one per distinct (partition, order) spec so each
+        gets exactly one sort) and return the $winref -> channel rewrite."""
+
+        def covered(e: RowExpression) -> bool:
+            if e in agg_rewrite or isinstance(e, Literal):
+                return True
+            if isinstance(e, Call):
+                return all(covered(a) for a in e.args)
+            return False
+
+        def prep(e: RowExpression) -> RowExpression:
+            if require_covered and not covered(e):
+                raise AnalysisError(
+                    f"'{e}' in window specification must be an aggregate "
+                    "expression or appear in GROUP BY clause")
+            return rewrite_expr(e, agg_rewrite)
+
+        groups: dict = {}
+        group_order: list = []
+        for idx, spec in enumerate(wcollector.calls):
+            partition = tuple(prep(p) for p in spec.partition)
+            order = tuple(
+                (prep(k.expr), k.ascending, k.nulls_first) for k in spec.order)
+            args = tuple(prep(a) for a in spec.args)
+            key = (partition, order)
+            if key not in groups:
+                groups[key] = []
+                group_order.append(key)
+            groups[key].append((idx, spec, args))
+
+        win_rewrite: dict[RowExpression, RowExpression] = {}
+        for key in group_order:
+            partition, order = key
+            calls = groups[key]
+            pending: list[RowExpression] = []
+
+            def channel_of(e: RowExpression) -> int:
+                if isinstance(e, InputRef):
+                    return e.index
+                for j, pe in enumerate(pending):
+                    if pe == e:
+                        return rel.width + j
+                pending.append(e)
+                return rel.width + len(pending) - 1
+
+            pch = [channel_of(p) for p in partition]
+            okeys = [SortKey(channel_of(oe), asc, nf)
+                     for (oe, asc, nf) in order]
+            funcs = []
+            for _idx, spec, args in calls:
+                ach = tuple(channel_of(a) for a in args)
+                funcs.append(WindowFunc(spec.fn, ach, spec.type,
+                                        spec.offset, spec.frame))
+            if pending:
+                rel = rel.append(
+                    pending, [f"_wk{rel.width + j}"
+                              for j in range(len(pending))])
+            base = rel.width
+            names = tuple(rel.node.output_names) + tuple(
+                f"_win{base + j}" for j in range(len(calls)))
+            types = tuple(rel.node.output_types) + tuple(
+                spec.type for (_i, spec, _a) in calls)
+            node = Window(names, types, rel.node, tuple(pch), tuple(okeys),
+                          tuple(funcs))
+            rel = RelationPlan(node, rel.qualifiers + [None] * len(calls))
+            for j, (idx, spec, _args) in enumerate(calls):
+                placeholder = Call(spec.type, "$winref",
+                                   (Literal(BIGINT, idx),))
+                win_rewrite[placeholder] = InputRef(spec.type, base + j)
+        return rel, win_rewrite
 
     # ------------------------------------------------------------ relations
     def plan_relation(self, r: ast.Relation, outer: Optional[Scope],

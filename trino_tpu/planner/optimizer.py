@@ -25,6 +25,7 @@ from ..spi.types import BOOLEAN
 from ..sql.ir import Call, InputRef, Literal, RowExpression, walk
 from .plan import (
     Aggregate,
+    DistinctLimit,
     Exchange,
     Filter,
     Join,
@@ -38,6 +39,7 @@ from .plan import (
     TableWriter,
     TopN,
     Values,
+    Window,
 )
 
 __all__ = ["optimize", "estimate_rows"]
@@ -186,7 +188,8 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
         )
         return out, mapping
 
-    if isinstance(node, (Sort, TopN, Limit, TableWriter, Exchange)):
+    if isinstance(node, (Sort, TopN, Limit, TableWriter, Exchange,
+                         DistinctLimit)):
         child, m = _rewrite(node.source, catalog)
         kwargs = dict(source=child, output_names=child.output_names,
                       output_types=child.output_types)
@@ -195,6 +198,26 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
         if isinstance(node, Exchange):
             kwargs["partition_keys"] = tuple(m[k] for k in node.partition_keys)
         return replace(node, **kwargs), m
+
+    if isinstance(node, Window):
+        child, m = _rewrite(node.source, catalog)
+        sw_old = len(node.source.output_types)
+        sw_new = len(child.output_types)
+        funcs = tuple(
+            replace(f, args=tuple(m[a] for a in f.args))
+            for f in node.functions)
+        names = tuple(child.output_names) + tuple(
+            node.output_names[sw_old + j] for j in range(len(funcs)))
+        types = tuple(child.output_types) + tuple(f.type for f in funcs)
+        out = replace(
+            node, output_names=names, output_types=types, source=child,
+            partition_keys=tuple(m[k] for k in node.partition_keys),
+            order_keys=tuple(replace(k, channel=m[k.channel])
+                             for k in node.order_keys),
+            functions=funcs)
+        mapping = [m[i] for i in range(sw_old)] + [
+            sw_new + j for j in range(len(funcs))]
+        return out, mapping
 
     if isinstance(node, (TableScan, Values)):
         return node, _identity(node)
@@ -572,6 +595,35 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
                       output_names=child.output_names,
                       output_types=child.output_types)
         return out, cm
+
+    if isinstance(node, Window):
+        sw = len(node.source.output_types)
+        kept_fns = [j for j in range(len(node.functions)) if (sw + j) in needed]
+        child_needed = ({i for i in needed if i < sw}
+                        | set(node.partition_keys)
+                        | {k.channel for k in node.order_keys})
+        for j in kept_fns:
+            child_needed |= set(node.functions[j].args)
+        child, cm = _prune(node.source, child_needed)
+        sw_new = len(child.output_types)
+        funcs = tuple(
+            replace(node.functions[j],
+                    args=tuple(cm[a] for a in node.functions[j].args))
+            for j in kept_fns)
+        names = tuple(child.output_names) + tuple(
+            node.output_names[sw + j] for j in kept_fns)
+        types = tuple(child.output_types) + tuple(f.type for f in funcs)
+        out = replace(node, output_names=names, output_types=types,
+                      source=child,
+                      partition_keys=tuple(cm[k] for k in node.partition_keys),
+                      order_keys=tuple(replace(k, channel=cm[k.channel])
+                                       for k in node.order_keys),
+                      functions=funcs)
+        mapping: list[Optional[int]] = [cm[i] for i in range(sw)]
+        fn_map = {j: sw_new + newj for newj, j in enumerate(kept_fns)}
+        for j in range(len(node.functions)):
+            mapping.append(fn_map.get(j))
+        return out, mapping
 
     if isinstance(node, (Limit, Exchange, TableWriter)):
         if isinstance(node, TableWriter):

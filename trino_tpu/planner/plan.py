@@ -22,7 +22,7 @@ __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
-    "plan_text",
+    "Window", "WindowFunc", "plan_text",
 ]
 
 
@@ -157,6 +157,49 @@ class SortKey:
     channel: int
     ascending: bool = True
     nulls_first: bool = False
+
+
+# default SQL frame: RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+DEFAULT_FRAME = ("RANGE", "UNBOUNDED_PRECEDING", None, "CURRENT", None)
+
+
+@dataclass(frozen=True)
+class WindowFunc:
+    """One window function call: ``args`` are input channels (value column,
+    then the lag/lead default channel when present); ``offset`` carries the
+    constant lag/lead offset, ntile bucket count, or nth_value position."""
+
+    fn: str
+    args: tuple[int, ...]
+    type: Type = None
+    offset: int = 1
+    frame: tuple = DEFAULT_FRAME
+
+
+@dataclass(frozen=True)
+class Window(PlanNode):
+    """Window evaluation (reference: sql/planner/plan/WindowNode.java,
+    operator/WindowOperator.java:69).  Output channels = every source channel
+    followed by one channel per function."""
+
+    source: PlanNode = None
+    partition_keys: tuple[int, ...] = ()
+    order_keys: tuple[SortKey, ...] = ()
+    functions: tuple[WindowFunc, ...] = ()
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        fns = ", ".join(
+            f"{f.fn}({', '.join('#%d' % a for a in f.args)})"
+            for f in self.functions)
+        keys = ", ".join(
+            f"#{k.channel}{'' if k.ascending else ' desc'}"
+            for k in self.order_keys)
+        return (f"Window[partition={list(self.partition_keys)} "
+                f"order=[{keys}] {fns}]")
 
 
 @dataclass(frozen=True)

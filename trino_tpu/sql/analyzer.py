@@ -38,9 +38,9 @@ from . import ast
 from .ir import Call, InputRef, Literal, OuterRef, RowExpression
 
 __all__ = [
-    "Field", "Scope", "Translator", "AggregateCollector", "AnalysisError",
-    "AGG_FUNCTIONS", "cast_to", "rewrite_expr", "split_conjuncts",
-    "agg_result_type",
+    "Field", "Scope", "Translator", "AggregateCollector", "WindowCollector",
+    "AnalysisError", "AGG_FUNCTIONS", "WINDOW_FUNCTIONS", "cast_to",
+    "rewrite_expr", "split_conjuncts", "agg_result_type",
 ]
 
 
@@ -49,6 +49,14 @@ class AnalysisError(ValueError):
 
 
 AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "any_value"}
+
+# pure window (ranking/navigation) functions; aggregates are also legal
+# with an OVER clause (reference: sql/analyzer/ExpressionAnalyzer window
+# resolution + operator/window/*)
+WINDOW_FUNCTIONS = {
+    "rank", "dense_rank", "row_number", "ntile", "percent_rank", "cume_dist",
+    "lag", "lead", "first_value", "last_value", "nth_value",
+}
 
 _SCALAR_TYPES: dict[str, str] = {
     # name -> rule tag used below
@@ -118,6 +126,41 @@ class AggregateCollector:
         return len(self.calls) - 1
 
 
+@dataclass(frozen=True)
+class WindowOrderKey:
+    expr: RowExpression
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+@dataclass(frozen=True)
+class WindowCallSpec:
+    """A fully-translated window call awaiting planning."""
+
+    fn: str
+    args: tuple[RowExpression, ...]  # value exprs (lag/lead default last)
+    offset: int  # lag/lead offset, ntile count, nth_value position
+    partition: tuple[RowExpression, ...]
+    order: tuple[WindowOrderKey, ...]
+    frame: tuple  # (unit, start_kind, start_val, end_kind, end_val)
+    type: Type
+
+
+class WindowCollector:
+    """Dedups window calls; translation returns $winref placeholders the
+    planner rewrites to Window-node output channels."""
+
+    def __init__(self):
+        self.calls: list[WindowCallSpec] = []
+
+    def add(self, spec: WindowCallSpec) -> int:
+        for i, s in enumerate(self.calls):
+            if s == spec:
+                return i
+        self.calls.append(spec)
+        return len(self.calls) - 1
+
+
 def agg_result_type(fn: str, arg_type: Optional[Type]) -> Type:
     if fn == "count":
         return BIGINT
@@ -181,10 +224,12 @@ class Translator:
         scope: Scope,
         aggregates: Optional[AggregateCollector] = None,
         subquery_cb: Optional[Callable[[ast.Expr], RowExpression]] = None,
+        windows: Optional["WindowCollector"] = None,
     ):
         self.scope = scope
         self.aggregates = aggregates
         self.subquery_cb = subquery_cb
+        self.windows = windows
 
     # -- entry -------------------------------------------------------------
     def translate(self, e: ast.Expr) -> RowExpression:
@@ -415,6 +460,10 @@ class Translator:
     # -- function calls ----------------------------------------------------
     def _t_FunctionCall(self, e: ast.FunctionCall) -> RowExpression:
         name = e.name.lower()
+        if e.window is not None:
+            return self._t_window_call(e)
+        if name in WINDOW_FUNCTIONS:
+            raise AnalysisError(f"{name} requires an OVER clause")
         if name in AGG_FUNCTIONS or (name == "count" and e.is_star):
             if self.aggregates is None:
                 raise AnalysisError(f"aggregate {name} not allowed here")
@@ -428,14 +477,21 @@ class Translator:
             idx = self.aggregates.add(name, arg, e.distinct, out_t)
             return Call(out_t, "$aggref", (Literal(BIGINT, idx),))
         if name == "coalesce":
-            args = [self.translate(a) for a in e.args]
-            out_t = UNKNOWN
-            for a in args:
-                c = common_super_type(out_t, a.type)
-                if c is None:
-                    raise AnalysisError("COALESCE argument types differ")
-                out_t = c
-            return Call(out_t, "$coalesce", tuple(cast_to(a, out_t) for a in args))
+            return self._t_coalesce(e)
+        return self._t_scalar_call(e)
+
+    def _t_coalesce(self, e: ast.FunctionCall) -> RowExpression:
+        args = [self.translate(a) for a in e.args]
+        out_t = UNKNOWN
+        for a in args:
+            c = common_super_type(out_t, a.type)
+            if c is None:
+                raise AnalysisError("COALESCE argument types differ")
+            out_t = c
+        return Call(out_t, "$coalesce", tuple(cast_to(a, out_t) for a in args))
+
+    def _t_scalar_call(self, e: ast.FunctionCall) -> RowExpression:
+        name = e.name.lower()
         if name == "nullif":
             a = self.translate(e.args[0])
             b = self.translate(e.args[1])
@@ -456,3 +512,88 @@ class Translator:
         else:
             out_t = VARCHAR
         return Call(out_t, name, args)
+
+    # -- window calls ------------------------------------------------------
+    def _const_int(self, e: ast.Expr, what: str) -> int:
+        ir = self.translate(e)
+        if isinstance(ir, Literal) and isinstance(ir.value, int):
+            return ir.value
+        raise AnalysisError(f"{what} must be an integer constant")
+
+    def _t_window_call(self, e: ast.FunctionCall) -> RowExpression:
+        if self.windows is None:
+            raise AnalysisError("window function not allowed here")
+        name = e.name.lower()
+        w = e.window
+        partition = tuple(self.translate(p) for p in w.partition_by)
+        order = tuple(
+            WindowOrderKey(
+                self.translate(s.expr), s.ascending,
+                s.nulls_first if s.nulls_first is not None else not s.ascending)
+            for s in w.order_by)
+        if w.frame is not None:
+            if w.frame.start.kind == "UNBOUNDED_FOLLOWING" or \
+                    w.frame.end.kind == "UNBOUNDED_PRECEDING":
+                raise AnalysisError("invalid window frame bounds")
+            fr = (w.frame.unit, w.frame.start.kind, w.frame.start.value,
+                  w.frame.end.kind, w.frame.end.value)
+        else:
+            fr = ("RANGE", "UNBOUNDED_PRECEDING", None, "CURRENT", None)
+        args: tuple[RowExpression, ...] = ()
+        offset = 1
+        if name in ("rank", "dense_rank", "row_number", "percent_rank",
+                    "cume_dist"):
+            if e.args:
+                raise AnalysisError(f"{name} takes no arguments")
+            out_t = DOUBLE if name in ("percent_rank", "cume_dist") else BIGINT
+        elif name == "ntile":
+            offset = self._const_int(e.args[0], "ntile bucket count")
+            if offset <= 0:
+                raise AnalysisError("ntile bucket count must be positive")
+            out_t = BIGINT
+        elif name in ("lag", "lead"):
+            arg = self.translate(e.args[0])
+            if len(e.args) > 1:
+                offset = self._const_int(e.args[1], f"{name} offset")
+            out_t = arg.type
+            args = (arg,)
+            if len(e.args) > 2:
+                d = self.translate(e.args[2])
+                common = common_super_type(out_t, d.type)
+                if common is None:
+                    raise AnalysisError(f"{name} default type mismatch")
+                out_t = common
+                args = (cast_to(arg, common), cast_to(d, common))
+        elif name in ("first_value", "last_value"):
+            arg = self.translate(e.args[0])
+            out_t = arg.type
+            args = (arg,)
+        elif name == "nth_value":
+            arg = self.translate(e.args[0])
+            offset = self._const_int(e.args[1], "nth_value position")
+            if offset <= 0:
+                raise AnalysisError("nth_value position must be positive")
+            out_t = arg.type
+            args = (arg,)
+        elif name == "count" and (e.is_star or not e.args):
+            name = "count_star"
+            out_t = BIGINT
+        elif name in AGG_FUNCTIONS:
+            if e.distinct:
+                raise AnalysisError("DISTINCT window aggregates not supported")
+            arg = self.translate(e.args[0])
+            if name == "avg":
+                out_t = DOUBLE
+                args = (cast_to(arg, DOUBLE),)
+            elif name == "any_value":
+                name = "first_value"
+                out_t = arg.type
+                args = (arg,)
+            else:
+                out_t = agg_result_type(name, arg.type)
+                args = (arg,)
+        else:
+            raise AnalysisError(f"not a window function: {name}")
+        spec = WindowCallSpec(name, args, offset, partition, order, fr, out_t)
+        idx = self.windows.add(spec)
+        return Call(out_t, "$winref", (Literal(BIGINT, idx),))

@@ -155,11 +155,35 @@ class Like(Expr):
 
 
 @dataclass(frozen=True)
+class FrameBound:
+    """One window-frame endpoint: kind in (UNBOUNDED_PRECEDING, PRECEDING,
+    CURRENT, FOLLOWING, UNBOUNDED_FOLLOWING); value set for the offset kinds."""
+
+    kind: str
+    value: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    unit: str  # ROWS | RANGE
+    start: FrameBound = FrameBound("UNBOUNDED_PRECEDING")
+    end: FrameBound = FrameBound("CURRENT")
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple["SortItem", ...] = ()
+    frame: Optional[WindowFrame] = None
+
+
+@dataclass(frozen=True)
 class FunctionCall(Expr):
     name: str
     args: tuple[Expr, ...]
     distinct: bool = False
     is_star: bool = False  # count(*)
+    window: Optional[WindowSpec] = None  # fn(...) OVER (...)
 
 
 @dataclass(frozen=True)
@@ -245,15 +269,31 @@ class QuerySpec:
 
 
 @dataclass(frozen=True)
+class SetOp:
+    """UNION / INTERSECT / EXCEPT (reference: sql/tree/Union.java,
+    Intersect.java, Except.java; planned via SetOperationNode)."""
+
+    op: str  # UNION | INTERSECT | EXCEPT
+    distinct: bool
+    left: "QueryBody"
+    right: "QueryBody"
+
+
+@dataclass(frozen=True)
 class WithQuery:
     name: str
     query: "Query"
     column_names: Optional[tuple[str, ...]] = None
 
 
+# a query body is a SELECT spec, a set operation over bodies, or a nested
+# parenthesized query (which may carry its own ORDER BY / LIMIT)
+QueryBody = Union["QuerySpec", "SetOp", "Query"]
+
+
 @dataclass(frozen=True)
 class Query:
-    body: QuerySpec
+    body: QueryBody
     order_by: tuple[SortItem, ...] = ()
     limit: Optional[int] = None
     with_: tuple[WithQuery, ...] = ()

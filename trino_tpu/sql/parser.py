@@ -58,6 +58,7 @@ KEYWORDS = {
     "quarter", "hour", "minute", "second", "asc", "desc", "nulls", "first",
     "last", "explain", "analyze", "create", "table", "insert", "into",
     "values", "show", "tables", "columns", "describe", "substring", "for",
+    "over",
 }
 
 
@@ -571,7 +572,8 @@ class _Parser:
                 self.expect_op("(")
                 if self.accept_op("*"):
                     self.expect_op(")")
-                    return ast.FunctionCall(name, (), is_star=True)
+                    return self._maybe_window(
+                        ast.FunctionCall(name, (), is_star=True))
                 distinct = bool(self.accept_kw("distinct"))
                 args: list[ast.Expr] = []
                 if not self.peek_op(")"):
@@ -579,9 +581,72 @@ class _Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
-                return ast.FunctionCall(name, tuple(args), distinct)
+                return self._maybe_window(
+                    ast.FunctionCall(name, tuple(args), distinct))
             return ast.ColumnRef((self.advance().text,))
         self.fail("expected expression")
+
+    # -- window (OVER clause; SqlBase.g4 windowSpecification) --------------
+    def accept_word(self, *words: str) -> Optional[str]:
+        """Context-sensitive non-reserved word (ident or keyword token)."""
+        t = self.cur
+        if t.kind in ("kw", "ident") and t.text.lower() in words:
+            self.advance()
+            return t.text.lower()
+        return None
+
+    def expect_word(self, word: str) -> None:
+        if not self.accept_word(word):
+            self.fail(f"expected {word.upper()}")
+
+    def _maybe_window(self, fc: ast.FunctionCall) -> ast.Expr:
+        if not self.accept_kw("over"):
+            return fc
+        self.expect_op("(")
+        partition: tuple[ast.Expr, ...] = ()
+        if self.accept_word("partition"):
+            self.expect_kw("by")
+            parts = [self.parse_expr()]
+            while self.accept_op(","):
+                parts.append(self.parse_expr())
+            partition = tuple(parts)
+        order: tuple[ast.SortItem, ...] = ()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = tuple(self.parse_sort_items())
+        frame = None
+        unit = self.accept_word("rows", "range")
+        if unit:
+            if self.accept_kw("between"):
+                start = self._frame_bound()
+                self.expect_kw("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ast.FrameBound("CURRENT")
+            frame = ast.WindowFrame(unit.upper(), start, end)
+        self.expect_op(")")
+        from dataclasses import replace
+
+        return replace(fc, window=ast.WindowSpec(partition, order, frame))
+
+    def _frame_bound(self) -> ast.FrameBound:
+        if self.accept_word("unbounded"):
+            d = self.accept_word("preceding", "following")
+            if d is None:
+                self.fail("expected PRECEDING or FOLLOWING")
+            return ast.FrameBound(f"UNBOUNDED_{d.upper()}")
+        if self.accept_word("current"):
+            self.expect_word("row")
+            return ast.FrameBound("CURRENT")
+        t = self.cur
+        if t.kind != "number":
+            self.fail("expected frame bound")
+        n = int(self.advance().text)
+        d = self.accept_word("preceding", "following")
+        if d is None:
+            self.fail("expected PRECEDING or FOLLOWING")
+        return ast.FrameBound(d.upper(), n)
 
     def parse_case(self) -> ast.Expr:
         self.expect_kw("case")

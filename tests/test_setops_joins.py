@@ -1,0 +1,155 @@
+"""Set operations (UNION/INTERSECT/EXCEPT) + RIGHT/FULL joins vs the
+sqlite oracle (the reference covers these in
+testing/trino-testing/.../AbstractTestQueries and TestJoinQueries)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.testing.oracle import SqliteOracle, assert_same_rows
+
+TABLES = ["nation", "region", "supplier", "customer", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def harness():
+    catalog = default_catalog(scale_factor=0.01)
+    runner = StandaloneQueryRunner(catalog)
+    dist = DistributedQueryRunner(catalog, worker_count=3)
+    oracle = SqliteOracle()
+    conn = catalog.connector("tpch")
+    for t in TABLES:
+        schema = conn.get_table_schema(t)
+        cols = schema.column_names()
+        batches = []
+        for s in conn.get_splits(t, 2, 1):
+            src = conn.create_page_source(s, cols)
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is not None:
+                    batches.append(b)
+        oracle.load_table(t, batches)
+    return runner, dist, oracle
+
+
+SETOP_QUERIES = [
+    "select n_regionkey from nation union select r_regionkey from region",
+    "select n_regionkey from nation union all select r_regionkey from region",
+    "select n_regionkey from nation intersect select r_regionkey from region",
+    "select n_regionkey from nation except select r_regionkey from region where r_regionkey < 3",
+    # mixed types: bigint vs literal double promotes
+    "select n_regionkey from nation union select 1.5",
+    # strings through dictionary unification
+    "select n_name from nation where n_regionkey = 0 union select r_name from region",
+    "select n_name from nation intersect select n_name from nation where n_regionkey > 2",
+    # set op under aggregation
+    "select count(*) from (select n_regionkey from nation union "
+    "select r_regionkey from region)",
+    # CTE with set-op body and column aliases
+    "with keys(k) as (select n_regionkey from nation union "
+    "select r_regionkey + 2 from region) select k from keys where k > 1",
+    # NULLs compare equal in set semantics
+    "select case when n_regionkey > 2 then null else n_regionkey end from nation "
+    "union select null",
+]
+
+OUTER_JOIN_QUERIES = [
+    "select n_name, r_name from region right join nation on n_regionkey = r_regionkey",
+    "select n_name, r_name from region right join nation "
+    "on n_regionkey = r_regionkey and r_regionkey < 2",
+    "select n_nationkey, r_regionkey from nation full join region "
+    "on n_nationkey = r_regionkey",
+    "select n_nationkey, r_regionkey from nation full outer join region "
+    "on n_nationkey = r_regionkey and n_nationkey <> 1",
+    # full join where both sides have unmatched rows
+    "select a.n_nationkey, b.n_nationkey from "
+    "(select n_nationkey from nation where n_nationkey < 10) a full join "
+    "(select n_nationkey from nation where n_nationkey >= 5) b "
+    "on a.n_nationkey = b.n_nationkey",
+    # right join with aggregation above
+    "select r_name, count(n_nationkey) from nation right join region "
+    "on n_regionkey = r_regionkey and n_nationkey < 3 group by r_name",
+    # larger tables: customers without orders kept by FULL
+    "select count(*) from orders full join customer on o_custkey = c_custkey",
+    "select count(*) from orders right join customer on o_custkey = c_custkey",
+]
+
+
+@pytest.mark.parametrize("sql", SETOP_QUERIES)
+def test_setops_standalone(harness, sql):
+    runner, _, oracle = harness
+    assert_same_rows(runner.execute(sql).rows(), oracle.query(sql))
+
+
+@pytest.mark.parametrize("sql", SETOP_QUERIES)
+def test_setops_distributed(harness, sql):
+    _, dist, oracle = harness
+    assert_same_rows(dist.execute(sql).rows(), oracle.query(sql))
+
+
+@pytest.mark.parametrize("sql", OUTER_JOIN_QUERIES)
+def test_outer_joins_standalone(harness, sql):
+    runner, _, oracle = harness
+    assert_same_rows(runner.execute(sql).rows(), oracle.query(sql))
+
+
+@pytest.mark.parametrize("sql", OUTER_JOIN_QUERIES)
+def test_outer_joins_distributed(harness, sql):
+    _, dist, oracle = harness
+    assert_same_rows(dist.execute(sql).rows(), oracle.query(sql))
+
+
+def test_setop_precedence(harness):
+    """INTERSECT binds tighter than UNION (SQL standard; sqlite flattens
+    left-to-right, so the oracle gets the grouping via a subquery)."""
+    runner, _, oracle = harness
+    sql = ("select n_regionkey from nation union select r_regionkey from "
+           "region intersect select r_regionkey from region where r_regionkey < 2")
+    expected = oracle.query(
+        "select n_regionkey from nation union select * from (select "
+        "r_regionkey from region intersect select r_regionkey from region "
+        "where r_regionkey < 2)")
+    assert_same_rows(runner.execute(sql).rows(), expected)
+
+
+def test_parenthesized_query_terms(harness):
+    """Each side's ORDER BY/LIMIT applies inside its parens (sqlite cannot
+    parse this form, so the oracle gets subquery-wrapped equivalents)."""
+    runner, dist, oracle = harness
+    sql = ("(select n_nationkey from nation order by n_nationkey limit 3) "
+           "union all "
+           "(select n_nationkey from nation order by n_nationkey desc limit 2)")
+    expected = oracle.query(
+        "select * from (select n_nationkey from nation order by n_nationkey "
+        "limit 3) union all select * from (select n_nationkey from nation "
+        "order by n_nationkey desc limit 2)")
+    assert_same_rows(runner.execute(sql).rows(), expected)
+    assert_same_rows(dist.execute(sql).rows(), expected)
+
+
+def test_distributed_union_values_not_duplicated(harness):
+    """A Values (FROM-less) union input must not be replayed once per task
+    of a multi-task union fragment."""
+    _, dist, _ = harness
+    rows = dist.execute(
+        "select n_regionkey from nation union all select 99").rows()
+    assert rows.count((99,)) == 1
+    assert len(rows) == 26
+
+
+def test_fromless_select(harness):
+    runner, _, _ = harness
+    assert runner.execute("select 1 as x, 'a' as s").rows() == [(1, "a")]
+
+
+def test_union_column_count_mismatch(harness):
+    runner, _, _ = harness
+    with pytest.raises(Exception, match="column count"):
+        runner.execute("select 1, 2 union select 3")
+
+
+def test_intersect_all_rejected(harness):
+    runner, _, _ = harness
+    with pytest.raises(Exception, match="not yet supported"):
+        runner.execute("select 1 intersect all select 1")

@@ -29,6 +29,7 @@ from .operators import (
     JoinBridge,
     JoinBuildSink,
     LimitOperator,
+    LocalUnionBridge,
     LookupJoinOperator,
     Operator,
     OutputCollector,
@@ -38,6 +39,8 @@ from .operators import (
     SortOperator,
     TableWriterOperator,
     TopNOperator,
+    UnionSinkOperator,
+    UnionSourceOperator,
     ValuesOperator,
     WindowOperator,
 )
@@ -136,6 +139,14 @@ class LocalPlanner:
                 bridge, node.source_keys, node.null_aware, node.residual,
                 node.output_names, node.output_types))
             return chain
+
+        if isinstance(node, P.Union):
+            bridge = LocalUnionBridge(len(node.sources))
+            for src in node.sources:
+                chain = self._chain(src)
+                chain.append(UnionSinkOperator(bridge, node.output_names))
+                self.pipelines.append(chain)
+            return [UnionSourceOperator(bridge)]
 
         if isinstance(node, P.Window):
             chain = self._chain(node.source)

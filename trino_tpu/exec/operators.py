@@ -39,6 +39,9 @@ __all__ = [
     "Operator",
     "ScanOperator",
     "ValuesOperator",
+    "LocalUnionBridge",
+    "UnionSinkOperator",
+    "UnionSourceOperator",
     "FilterProjectOperator",
     "HashAggregationOperator",
     "JoinBridge",
@@ -139,6 +142,65 @@ class ValuesOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._batch is None
+
+
+# ---------------------------------------------------------------------------
+# union (local gather between pipelines)
+
+
+class LocalUnionBridge:
+    """In-task handoff for Union inputs: each input pipeline ends in a
+    UnionSinkOperator appending here; the consumer pipeline starts from a
+    UnionSourceOperator.  The single-driver analogue of a gathering
+    LocalExchange (reference: operator/exchange/LocalExchange.java:67)."""
+
+    def __init__(self, num_inputs: int):
+        from collections import deque
+
+        self.num_inputs = num_inputs
+        self.batches: "deque[ColumnBatch]" = deque()
+        self.finished_inputs = 0
+
+    @property
+    def all_finished(self) -> bool:
+        return self.finished_inputs >= self.num_inputs
+
+
+class UnionSinkOperator(Operator):
+    def __init__(self, bridge: LocalUnionBridge, names: Sequence[str]):
+        self.bridge = bridge
+        self.names = list(names)
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        if batch.num_rows:
+            self.bridge.batches.append(batch.rename(self.names))
+
+    def finish_input(self) -> None:
+        super().finish_input()
+        self.bridge.finished_inputs += 1
+
+    def is_finished(self) -> bool:
+        return self.input_done
+
+
+class UnionSourceOperator(Operator):
+    def __init__(self, bridge: LocalUnionBridge):
+        self.bridge = bridge
+        self.input_done = True
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        if self._closed or not self.bridge.all_finished:
+            return None
+        if self.bridge.batches:
+            return self.bridge.batches.popleft()
+        return None
+
+    def is_finished(self) -> bool:
+        return self._closed or (self.bridge.all_finished
+                                and not self.bridge.batches)
 
 
 # ---------------------------------------------------------------------------
@@ -570,7 +632,11 @@ def _null_columns(batch: ColumnBatch, n: int) -> list[Column]:
 
 class LookupJoinOperator(Operator):
     """Probe side of the equi-join (operator/join/LookupJoinOperator.java:37).
-    Streams probe batches against the finished build table."""
+    Streams probe batches against the finished build table.  RIGHT/FULL
+    track matched build positions across all probe batches and emit the
+    unmatched build rows null-extended after input finishes (the
+    OUTER lookup-source variants of the reference —
+    operator/join/LookupJoinOperator probe-outer/build-outer modes)."""
 
     def __init__(self, bridge: JoinBridge, left_keys: Sequence[int],
                  join_type: str, residual: Optional[RowExpression],
@@ -583,6 +649,10 @@ class LookupJoinOperator(Operator):
         self.output_types = list(output_types)
         self._pending: Optional[ColumnBatch] = None
         self._residual_fn = None
+        self._build_matched: Optional[np.ndarray] = None
+        self._emitted_unmatched = False
+        # probe-side dictionaries observed, for null-extended unmatched rows
+        self._probe_dicts: Optional[list] = None
 
     def needs_input(self) -> bool:
         return self.bridge.ready and self._pending is None and super().needs_input()
@@ -618,7 +688,14 @@ class LookupJoinOperator(Operator):
                 mask = mask & np.asarray(valid)
             pi, bi = pi[mask], bi[mask]
 
-        if self.join_type in ("LEFT", "SINGLE"):
+        if self.join_type in ("RIGHT", "FULL"):
+            if self._build_matched is None:
+                self._build_matched = np.zeros(build.num_rows, bool)
+            if len(bi):
+                self._build_matched[np.asarray(bi)] = True
+            self._probe_dicts = [c.dictionary for c in probe.columns]
+
+        if self.join_type in ("LEFT", "SINGLE", "FULL"):
             matched = np.zeros(probe.num_rows, bool)
             matched[pi] = True
             alive = (np.ones(probe.num_rows, bool) if probe.live is None
@@ -649,12 +726,46 @@ class LookupJoinOperator(Operator):
         names = list(probe.names) + list(build.names)
         return ColumnBatch(names, cols)
 
+    def _unmatched_build_batch(self) -> Optional[ColumnBatch]:
+        """RIGHT/FULL epilogue: build rows no probe row matched, with NULL
+        probe-side columns."""
+        build = self.bridge.batch
+        if build is None or build.num_rows == 0:
+            return None
+        matched = (self._build_matched if self._build_matched is not None
+                   else np.zeros(build.num_rows, bool))
+        un = np.nonzero(~matched)[0]
+        if not len(un):
+            return None
+        lw = len(self.output_types) - build.num_columns
+        n = len(un)
+        left_cols = []
+        for i, t in enumerate(self.output_types[:lw]):
+            d = (self._probe_dicts[i]
+                 if self._probe_dicts is not None else None)
+            left_cols.append(Column(t, np.zeros(n, t.storage_dtype),
+                                    np.zeros(n, bool), d))
+        right_cols = [c.take(un) for c in build.columns]
+        return ColumnBatch(self.output_names, left_cols + right_cols)
+
     def get_output(self) -> Optional[ColumnBatch]:
-        b, self._pending = self._pending, None
-        return b
+        if self._pending is not None:
+            b, self._pending = self._pending, None
+            return b
+        if (self.input_done and not self._closed
+                and self.join_type in ("RIGHT", "FULL")
+                and not self._emitted_unmatched):
+            self._emitted_unmatched = True
+            return self._unmatched_build_batch()
+        return None
 
     def is_finished(self) -> bool:
-        return self.input_done and self._pending is None
+        if self._closed:
+            return True
+        done = self.input_done and self._pending is None
+        if self.join_type in ("RIGHT", "FULL"):
+            return done and self._emitted_unmatched
+        return done
 
 
 def _concat_valid(a: Column, b: Column) -> Optional[np.ndarray]:

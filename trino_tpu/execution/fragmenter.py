@@ -17,6 +17,7 @@ from ..planner.plan import (
     PlanNode,
     RemoteSource,
     TableScan,
+    Union,
     plan_text,
 )
 
@@ -89,6 +90,8 @@ class _Fragmenter:
         new_kids = [self._rewrite(c, sources, children) for c in kids]
         if all(a is b for a, b in zip(kids, new_kids)):
             return node
+        if isinstance(node, Union):
+            return replace(node, sources=tuple(new_kids))
         if len(kids) == 1:
             return replace(node, source=new_kids[0])
         return replace(node, left=new_kids[0], right=new_kids[1]) \

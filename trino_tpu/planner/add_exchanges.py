@@ -41,6 +41,7 @@ from .plan import (
     TableScan,
     TableWriter,
     TopN,
+    Union,
     Values,
     Window,
 )
@@ -86,6 +87,11 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         if node.distribution == "PARTITIONED" and node.left_keys:
             left = _exchange(left, "REPARTITION", node.left_keys)
             right = _exchange(right, "REPARTITION", node.right_keys)
+        elif node.join_type in ("RIGHT", "FULL"):
+            # keyless outer joins collapse to one task: a broadcast build
+            # would emit unmatched build rows once per task
+            left = _exchange(left, "GATHER")
+            right = _exchange(right, "GATHER")
         else:
             right = _exchange(right, "BROADCAST")
         out = Join(node.output_names, node.output_types, left, right,
@@ -148,6 +154,22 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         src = _visit(node.source, single=single)
         return _replace_source(node, src)
 
+    if isinstance(node, Union):
+        # each input stays in the union fragment: tasks union their own
+        # split shares; any required global dedup sits above as an Aggregate.
+        # A static (Values-only) input would be replayed identically by every
+        # task of a multi-task union fragment, so it gets its own SINGLE
+        # fragment via a GATHER edge.
+        from dataclasses import replace as _replace
+
+        srcs = []
+        for s in node.sources:
+            v = _visit(s, single=False)
+            if not _has_task_varying_source(v):
+                v = _exchange(v, "GATHER")
+            srcs.append(v)
+        return _gather_if(_replace(node, sources=tuple(srcs)), single)
+
     if isinstance(node, (TableScan, Values)):
         return _gather_if(node, single)
 
@@ -155,6 +177,15 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         return _replace_source(node, _visit(node.source, single=False))
 
     raise NotImplementedError(f"add_exchanges: {type(node).__name__}")
+
+
+def _has_task_varying_source(node: PlanNode) -> bool:
+    """True when the subtree's output differs per task (scans split by task;
+    exchange edges deliver per-task partitions).  Values-only subtrees are
+    task-invariant: every task would produce identical copies."""
+    if isinstance(node, (TableScan, Exchange)):
+        return True
+    return any(_has_task_varying_source(c) for c in node.children)
 
 
 def _replace_source(node, src):

@@ -49,6 +49,7 @@ from .plan import (
     TableScan,
     TableWriter,
     TopN,
+    Union,
     Values,
     Window,
     WindowFunc,
@@ -157,18 +158,10 @@ class LogicalPlanner:
         for w in q.with_:
             if w.column_names:
                 ctes[w.name] = replace(
-                    w.query,
-                    body=replace(
-                        w.query.body,
-                        select=tuple(
-                            replace(s, alias=cn)
-                            for s, cn in zip(w.query.body.select, w.column_names)
-                        ),
-                    ),
-                )
+                    w.query, body=_alias_body(w.query.body, w.column_names))
             else:
                 ctes[w.name] = w.query
-        rel, select_irs = self.plan_spec(q.body, outer, ctes)
+        rel, select_irs = self.plan_body(q.body, outer, ctes)
 
         # ORDER BY / LIMIT over the projected relation
         if q.order_by:
@@ -221,15 +214,96 @@ class LogicalPlanner:
         _, translate = ctx
         return translate
 
+    # ----------------------------------------------------------------- body
+    def plan_body(self, body: ast.QueryBody, outer: Optional[Scope],
+                  ctes: dict[str, ast.Query]) -> tuple[RelationPlan, list[RowExpression]]:
+        if isinstance(body, ast.QuerySpec):
+            return self.plan_spec(body, outer, ctes)
+        if isinstance(body, ast.Query):  # parenthesized query term
+            rel = self.plan_query(body, outer, ctes)
+            return rel, [InputRef(t, i)
+                         for i, t in enumerate(rel.node.output_types)]
+        if isinstance(body, ast.SetOp):
+            rel = self.plan_setop(body, outer, ctes)
+            return rel, [InputRef(t, i)
+                         for i, t in enumerate(rel.node.output_types)]
+        raise AnalysisError(f"unsupported query body: {type(body).__name__}")
+
+    def plan_setop(self, op: ast.SetOp, outer, ctes) -> RelationPlan:
+        """UNION/INTERSECT/EXCEPT (reference: sql/planner/plan/
+        SetOperationNode.java lowered per SetOperationNodeTranslator):
+        UNION ALL -> Union; the distinct variants -> Union of marker-tagged
+        inputs + group-by-all-channels counting each side + Filter.  Group-
+        based lowering gives SQL set semantics (NULLs compare equal) for
+        free because the grouping kernel treats NULL as one group."""
+        left = self.plan_body(op.left, outer, ctes)[0]
+        right = self.plan_body(op.right, outer, ctes)[0]
+        if left.width != right.width:
+            raise AnalysisError(
+                f"{op.op} inputs have different column counts: "
+                f"{left.width} vs {right.width}")
+        from ..spi.types import common_super_type
+
+        types = []
+        for i, (lt, rt) in enumerate(zip(left.node.output_types,
+                                         right.node.output_types)):
+            c = common_super_type(lt, rt)
+            if c is None:
+                raise AnalysisError(
+                    f"{op.op} column {i + 1} type mismatch: {lt} vs {rt}")
+            types.append(c)
+        names = tuple(left.node.output_names)
+        sides = [_cast_side(left, types), _cast_side(right, types)]
+
+        if op.op == "UNION":
+            un = Union(names, tuple(types), tuple(s.node for s in sides))
+            rel = RelationPlan(un, [None] * len(names))
+            if op.distinct:
+                agg = Aggregate(un.output_names, un.output_types, un,
+                                tuple(range(len(names))), ())
+                rel = RelationPlan(agg, [None] * len(names))
+            return rel
+        if not op.distinct:
+            raise AnalysisError(f"{op.op} ALL not yet supported")
+
+        # INTERSECT / EXCEPT [DISTINCT]: tag each side, count per group
+        w = len(names)
+        tagged = []
+        for si, s in enumerate(sides):
+            marks = [Literal(BIGINT, 1 if si == 0 else 0),
+                     Literal(BIGINT, 1 if si == 1 else 0)]
+            tagged.append(s.append(marks, ["_l", "_r"]).node)
+        un = Union(names + ("_l", "_r"), tuple(types) + (BIGINT, BIGINT),
+                   tuple(tagged))
+        aggs = (AggCall("sum", w, BIGINT), AggCall("sum", w + 1, BIGINT))
+        agg = Aggregate(names + ("_lc", "_rc"), tuple(types) + (BIGINT, BIGINT),
+                        un, tuple(range(w)), aggs)
+        lc = InputRef(BIGINT, w)
+        rc = InputRef(BIGINT, w + 1)
+        zero = Literal(BIGINT, 0)
+        if op.op == "INTERSECT":
+            pred = Call(BOOLEAN, "$and", (Call(BOOLEAN, "gt", (lc, zero)),
+                                          Call(BOOLEAN, "gt", (rc, zero))))
+        else:  # EXCEPT
+            pred = Call(BOOLEAN, "$and", (Call(BOOLEAN, "gt", (lc, zero)),
+                                          Call(BOOLEAN, "eq", (rc, zero))))
+        filt = Filter(agg.output_names, agg.output_types, agg, pred)
+        proj = Project(names, tuple(types), filt,
+                       tuple(InputRef(t, i) for i, t in enumerate(types)))
+        return RelationPlan(proj, [None] * len(names))
+
     # ----------------------------------------------------------------- spec
     def plan_spec(self, spec: ast.QuerySpec, outer: Optional[Scope],
                   ctes: dict[str, ast.Query]) -> tuple[RelationPlan, list[RowExpression]]:
+        # FROM-less SELECT evaluates over one synthetic row (the reference
+        # plans a single-row ValuesNode); the dummy channel is invisible to
+        # SELECT * via star_width=0
         rel = (self.plan_relation(spec.from_, outer, ctes)
                if spec.from_ is not None
-               else RelationPlan(Values((), (), rows=((),)), []))
+               else RelationPlan(Values(("_row",), (BIGINT,), rows=((0,),)), [None]))
         # capture the user-visible fields now: WHERE subquery handling appends
         # synthetic channels (_mark/_scalar/_key) that SELECT * must not see
-        star_width = rel.width
+        star_width = rel.width if spec.from_ is not None else 0
 
         # WHERE: plain conjuncts first (push down), then subquery conjuncts
         if spec.where is not None:
@@ -521,8 +595,6 @@ class LogicalPlanner:
         if j.join_type == "CROSS" or j.condition is None:
             node = Join(names, types, left.node, right.node, "CROSS", (), (), None)
             return RelationPlan(node, quals)
-        if j.join_type in ("RIGHT", "FULL"):
-            raise AnalysisError(f"{j.join_type} join not yet supported")
         combined = Scope(
             [Field(n, t, q) for n, t, q in zip(names, types, quals)], outer)
         tr = Translator(combined)
@@ -792,6 +864,30 @@ class LogicalPlanner:
             value_ref.type, "$if",
             (Call(BOOLEAN, "$is_null", (mark_ref,)), default_expr, value_ref))
         return new_rel, ir
+
+
+def _alias_body(body: ast.QueryBody, colnames: tuple[str, ...]) -> ast.QueryBody:
+    """Apply WITH-clause column aliases; a set operation takes its output
+    names from its leftmost input (SQL spec 7.13)."""
+    if isinstance(body, ast.QuerySpec):
+        return replace(body, select=tuple(
+            replace(s, alias=cn) for s, cn in zip(body.select, colnames)))
+    if isinstance(body, ast.SetOp):
+        return replace(body, left=_alias_body(body.left, colnames))
+    if isinstance(body, ast.Query):
+        return replace(body, body=_alias_body(body.body, colnames))
+    return body
+
+
+def _cast_side(rel: RelationPlan, types: list) -> RelationPlan:
+    """Project a set-op input so its channel types match the unified types."""
+    if list(rel.node.output_types) == list(types):
+        return rel
+    exprs = tuple(
+        cast_to(InputRef(t0, i), t)
+        for i, (t0, t) in enumerate(zip(rel.node.output_types, types)))
+    node = Project(tuple(rel.node.output_names), tuple(types), rel.node, exprs)
+    return RelationPlan(node, list(rel.qualifiers))
 
 
 def _index_of(ir, irs):

@@ -38,6 +38,7 @@ from .plan import (
     TableScan,
     TableWriter,
     TopN,
+    Union,
     Values,
     Window,
 )
@@ -101,6 +102,8 @@ def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
         return float(getattr(node, "count", 1000))
     if isinstance(node, Values):
         return float(len(node.rows))
+    if isinstance(node, Union):
+        return sum(estimate_rows(s, catalog) for s in node.sources)
     for c in node.children:
         return estimate_rows(c, catalog)
     return 1000.0
@@ -162,7 +165,7 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
             left_keys=tuple(lm[k] for k in node.left_keys),
             right_keys=tuple(rm[k] for k in node.right_keys),
             residual=residual,
-            distribution=_choose_distribution(right, catalog),
+            distribution=_choose_distribution(right, catalog, node.join_type),
         )
         return out, mapping
 
@@ -219,6 +222,15 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
             sw_new + j for j in range(len(funcs))]
         return out, mapping
 
+    if isinstance(node, Union):
+        new_sources = []
+        for s in node.sources:
+            child, m = _rewrite(s, catalog)
+            if m != list(range(len(child.output_types))):
+                child = _restore_layout(child, m, s)
+            new_sources.append(child)
+        return replace(node, sources=tuple(new_sources)), _identity(node)
+
     if isinstance(node, (TableScan, Values)):
         return node, _identity(node)
 
@@ -231,7 +243,13 @@ def _restore_layout(child: PlanNode, mapping: list[int], original: PlanNode) -> 
                    child, exprs)
 
 
-def _choose_distribution(build: PlanNode, catalog: Catalog) -> str:
+def _choose_distribution(build: PlanNode, catalog: Catalog,
+                         join_type: str = "INNER") -> str:
+    # RIGHT/FULL must partition: a broadcast build would emit its unmatched
+    # rows once per task (reference: DetermineJoinDistributionType.java —
+    # right/full joins cannot use REPLICATED)
+    if join_type in ("RIGHT", "FULL"):
+        return "PARTITIONED"
     return ("BROADCAST" if estimate_rows(build, catalog) <= _BROADCAST_LIMIT
             else "PARTITIONED")
 
@@ -624,6 +642,27 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
         for j in range(len(node.functions)):
             mapping.append(fn_map.get(j))
         return out, mapping
+
+    if isinstance(node, Union):
+        kept = sorted(needed) or [0]
+        new_sources = []
+        for s in node.sources:
+            child, cm = _prune(s, set(kept))
+            if [cm[i] for i in kept] != list(range(len(child.output_types))):
+                # re-project so every source keeps the identical layout
+                child = Project(
+                    tuple(node.output_names[i] for i in kept),
+                    tuple(node.output_types[i] for i in kept),
+                    child,
+                    tuple(InputRef(node.output_types[i], cm[i]) for i in kept))
+            new_sources.append(child)
+        out = Union(tuple(node.output_names[i] for i in kept),
+                    tuple(node.output_types[i] for i in kept),
+                    tuple(new_sources))
+        m: list[Optional[int]] = [None] * len(node.output_types)
+        for new, old in enumerate(kept):
+            m[old] = new
+        return out, m
 
     if isinstance(node, (Limit, Exchange, TableWriter)):
         if isinstance(node, TableWriter):

@@ -22,7 +22,7 @@ __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
-    "Window", "WindowFunc", "plan_text",
+    "Window", "WindowFunc", "Union", "plan_text",
 ]
 
 
@@ -252,6 +252,24 @@ class DistinctLimit(PlanNode):
     @property
     def children(self):
         return (self.source,)
+
+
+@dataclass(frozen=True)
+class Union(PlanNode):
+    """UNION ALL concatenation (reference: sql/planner/plan/UnionNode.java /
+    SetOperationNode.java).  Every source's channels line up 1:1 with the
+    output channels; INTERSECT/EXCEPT/UNION-DISTINCT are lowered by the
+    planner to Union + marker counts + Aggregate + Filter (the
+    SetOperationNodeTranslator strategy)."""
+
+    sources: tuple[PlanNode, ...] = ()
+
+    @property
+    def children(self):
+        return self.sources
+
+    def label(self) -> str:
+        return f"Union[{len(self.sources)} inputs]"
 
 
 @dataclass(frozen=True)

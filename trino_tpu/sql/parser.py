@@ -160,7 +160,7 @@ class _Parser:
             analyze = bool(self.accept_kw("analyze"))
             inner = self.parse_statement()
             return ast.Explain(inner, analyze=analyze)
-        if self.peek_kw("select", "with"):
+        if self.peek_kw("select", "with") or self.peek_op("("):
             return ast.QueryStatement(self.parse_query())
         if self.accept_kw("create"):
             self.expect_kw("table")
@@ -208,7 +208,7 @@ class _Parser:
                 withs.append(ast.WithQuery(name, q, colnames))
                 if not self.accept_op(","):
                     break
-        body = self.parse_query_spec()
+        body = self.parse_query_body()
         order_by: tuple[ast.SortItem, ...] = ()
         limit = None
         if self.accept_kw("order"):
@@ -244,6 +244,43 @@ class _Parser:
             items.append(ast.SortItem(e, asc, nulls_first))
             if not self.accept_op(","):
                 return items
+
+    def parse_query_body(self) -> ast.QueryBody:
+        """Set-operation precedence per SqlBase.g4 queryTerm: INTERSECT binds
+        tighter than UNION/EXCEPT; all are left-associative."""
+        left = self.parse_set_term()
+        while self.peek_kw("union", "except"):
+            op = self.advance().text.upper()
+            distinct = True
+            if self.accept_kw("all"):
+                distinct = False
+            else:
+                self.accept_kw("distinct")
+            right = self.parse_set_term()
+            left = ast.SetOp(op, distinct, left, right)
+        return left
+
+    def parse_set_term(self) -> ast.QueryBody:
+        left = self.parse_set_primary()
+        while self.peek_kw("intersect"):
+            self.advance()
+            distinct = True
+            if self.accept_kw("all"):
+                distinct = False
+            else:
+                self.accept_kw("distinct")
+            right = self.parse_set_primary()
+            left = ast.SetOp("INTERSECT", distinct, left, right)
+        return left
+
+    def parse_set_primary(self) -> ast.QueryBody:
+        if self.peek_op("("):
+            # parenthesized query (may carry its own ORDER BY / LIMIT)
+            self.advance()
+            q = self.parse_query()
+            self.expect_op(")")
+            return q
+        return self.parse_query_spec()
 
     def parse_query_spec(self) -> ast.QuerySpec:
         self.expect_kw("select")

@@ -168,7 +168,19 @@ def assert_same_rows(actual: Sequence[tuple], expected: Sequence[tuple],
     a = normalize_rows(actual, float_digits)
     e = normalize_rows(expected, float_digits)
     if not ordered:
-        key = lambda r: tuple((x is None, str(type(x)), x) for x in r)  # noqa: E731
+        # numbers sort together regardless of int/float representation
+        # (sqlite keeps literal ints where the engine produces decimals)
+        def key(r):
+            out = []
+            for x in r:
+                if x is None:
+                    out.append((1, "", 0.0, ""))
+                elif isinstance(x, (int, float)):
+                    out.append((0, "num", float(x), ""))
+                else:
+                    out.append((0, str(type(x)), 0.0, str(x)))
+            return tuple(out)
+
         a = sorted(a, key=key)
         e = sorted(e, key=key)
     assert len(a) == len(e), f"row count {len(a)} != expected {len(e)}\nactual head: {a[:5]}\nexpected head: {e[:5]}"
@@ -180,7 +192,11 @@ def _row_eq(a: tuple, b: tuple) -> bool:
     if len(a) != len(b):
         return False
     for x, y in zip(a, b):
-        if isinstance(x, float) and isinstance(y, float):
+        if isinstance(x, int) and isinstance(y, int):
+            if x != y:
+                return False
+        elif isinstance(x, (int, float)) and isinstance(y, (int, float)):
+            # representations may differ (sqlite int vs engine decimal/float)
             if not math.isclose(x, y, rel_tol=1e-6, abs_tol=1e-2):
                 return False
         elif x != y:

@@ -1,0 +1,128 @@
+"""Scalar + aggregate function breadth vs the sqlite oracle
+(reference: operator/scalar/*, operator/aggregation/*)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.testing.oracle import SqliteOracle, assert_same_rows
+
+TABLES = ["nation", "region", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def harness():
+    catalog = default_catalog(scale_factor=0.01)
+    runner = StandaloneQueryRunner(catalog)
+    dist = DistributedQueryRunner(catalog, worker_count=3)
+    oracle = SqliteOracle()
+    conn = catalog.connector("tpch")
+    for t in TABLES:
+        schema = conn.get_table_schema(t)
+        cols = schema.column_names()
+        batches = []
+        for s in conn.get_splits(t, 2, 1):
+            src = conn.create_page_source(s, cols)
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is not None:
+                    batches.append(b)
+        oracle.load_table(t, batches)
+    return runner, dist, oracle
+
+
+SCALAR_QUERIES = [
+    # string functions through dictionary transforms
+    "select n_name || '-' || n_comment from nation where n_regionkey = 1",
+    "select concat(n_name, '/', r_name) from nation, region "
+    "where n_regionkey = r_regionkey and n_nationkey < 5",
+    "select replace(n_name, 'A', 'x') from nation",
+    "select strpos(n_name, 'AN'), n_name from nation",
+    "select n_name from nation where starts_with(n_name, 'I')",
+    "select reverse(n_name) from nation where n_regionkey = 2",
+    # date functions
+    "select date_trunc('month', o_orderdate), count(*) from orders "
+    "group by date_trunc('month', o_orderdate)",
+    "select date_trunc('year', o_orderdate), date_trunc('quarter', o_orderdate), "
+    "date_trunc('week', o_orderdate) from orders limit 50",
+    "select day_of_week(o_orderdate), day_of_year(o_orderdate) from orders "
+    "limit 50",
+    # math
+    "select sign(o_totalprice - 100000), mod(o_orderkey, 7) from orders limit 100",
+    "select greatest(o_orderkey, o_custkey), least(o_orderkey, o_custkey) "
+    "from orders limit 100",
+    "select round(sin(o_orderkey), 4), round(cos(o_orderkey), 4) from orders limit 20",
+    # conditional
+    "select if(o_orderpriority = '1-URGENT', 1, 0), o_orderkey from orders limit 50",
+]
+
+AGG_QUERIES = [
+    "select stddev(l_quantity), variance(l_quantity) from lineitem",
+    "select var_pop(l_quantity), stddev_pop(l_quantity), var_samp(l_quantity), "
+    "stddev_samp(l_quantity) from lineitem",
+    "select l_returnflag, stddev(l_extendedprice), var_pop(l_discount) "
+    "from lineitem group by l_returnflag",
+    # single-row groups: var_samp NULL, var_pop 0
+    "select o_orderkey, var_samp(o_totalprice), var_pop(o_totalprice) "
+    "from orders where o_orderkey < 100 group by o_orderkey",
+    "select bool_and(o_totalprice > 1000), bool_or(o_orderpriority = '1-URGENT') "
+    "from orders",
+    "select o_orderstatus, count_if(o_totalprice > 150000) from orders "
+    "group by o_orderstatus",
+]
+
+
+@pytest.mark.parametrize("sql", SCALAR_QUERIES)
+def test_scalar_functions(harness, sql):
+    runner, _, oracle = harness
+    assert_same_rows(runner.execute(sql).rows(), oracle.query(sql))
+
+
+@pytest.mark.parametrize("sql", AGG_QUERIES)
+def test_agg_functions(harness, sql):
+    runner, _, oracle = harness
+    assert_same_rows(runner.execute(sql).rows(), oracle.query(sql))
+
+
+@pytest.mark.parametrize("sql", AGG_QUERIES)
+def test_agg_functions_distributed(harness, sql):
+    _, dist, oracle = harness
+    assert_same_rows(dist.execute(sql).rows(), oracle.query(sql))
+
+
+def test_approx_distinct(harness):
+    """approx_distinct is implemented as an exact distinct count (valid
+    within any approximation budget)."""
+    runner, _, oracle = harness
+    actual = runner.execute(
+        "select o_orderstatus, approx_distinct(o_custkey) from orders "
+        "group by o_orderstatus").rows()
+    expected = oracle.query(
+        "select o_orderstatus, count(distinct o_custkey) from orders "
+        "group by o_orderstatus")
+    assert_same_rows(actual, expected)
+
+
+def test_geometric_mean(harness):
+    runner, _, oracle = harness
+    actual = runner.execute(
+        "select geometric_mean(l_quantity) from lineitem").rows()
+    expected = oracle.query(
+        "select exp(avg(ln(l_quantity))) from lineitem")
+    assert_same_rows(actual, expected)
+
+
+def test_arbitrary_every(harness):
+    runner, _, _ = harness
+    rows = runner.execute(
+        "select arbitrary(n_regionkey), every(n_regionkey >= 0) from nation").rows()
+    assert rows[0][1] == 1 or rows[0][1] is True
+
+
+def test_fromless_scalars(harness):
+    runner, _, _ = harness
+    rows = runner.execute(
+        "select round(pi(), 4), round(e(), 4), round(degrees(pi()), 1), "
+        "truncate(2.71), round(cbrt(27.0), 6), log2(8)").rows()
+    assert [float(x) for x in rows[0]] == [3.1416, 2.7183, 180.0, 2.0, 3.0, 3.0]

@@ -547,8 +547,9 @@ def probe_join_table(
     for (pd, pv), bd in zip(probe_keys, table.key_datas):
         p, b = jnp.asarray(pd)[probe_id], bd[build_id]
         ok = ok & ~_neq(p, b)
-    keep = np.asarray(ok)
-    return np.asarray(probe_id)[keep], np.asarray(build_id)[keep]
+    # one device->host round trip for all three arrays (not three)
+    keep, probe_id, build_id = jax.device_get((ok, probe_id, build_id))
+    return probe_id[keep], build_id[keep]
 
 
 # ---------------------------------------------------------------------------

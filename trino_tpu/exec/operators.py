@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.expr import compile_expression
+from ..sql.analyzer import STAT_AGGS
 from ..spi.batch import Column, ColumnBatch, pad_to_bucket, unify_dictionaries
 from ..spi.connector import Connector, ConnectorPageSink, Split
 from ..spi.types import BIGINT, BOOLEAN, DOUBLE, DecimalType, Type, is_string
@@ -220,6 +221,14 @@ class FilterProjectOperator(Operator):
     resident between operators.  Replaces sql/gen/PageFunctionCompiler.java:
     104 bytecode + operator/ScanFilterAndProjectOperator.java:68 fusion."""
 
+    # Cross-execution program cache: operators are rebuilt per query run, but
+    # the jitted XLA program depends only on (expressions, input types,
+    # dictionaries, output dtypes).  jax.jit caches by function identity, so
+    # a fresh closure per run would recompile every time (~0.5-0.8s per
+    # program on a tunneled TPU).  Values hold their dictionary arrays so the
+    # id()-based key component can never be recycled by the allocator.
+    _PROGRAM_CACHE: dict = {}
+
     def __init__(self, predicate: Optional[RowExpression],
                  projections: Optional[Sequence[RowExpression]],
                  output_names: Sequence[str], output_types: Sequence[Type]):
@@ -238,6 +247,20 @@ class FilterProjectOperator(Operator):
         ):
             return self._compiled
         types = [c.type for c in batch.columns]
+        key = (
+            self.predicate,
+            None if self.projections is None else tuple(self.projections),
+            tuple(types),
+            tuple(id(d) if d is not None else None for d in dicts),
+            tuple(self.output_types),
+        )
+        cache = FilterProjectOperator._PROGRAM_CACHE
+        hit = cache.get(key)
+        if hit is not None:
+            self._compiled, self._compiled_dicts = hit[0], dicts
+            return self._compiled
+        if len(cache) >= 1024:  # bound: evict oldest (dict = insertion order)
+            cache.pop(next(iter(cache)))
         pred = (
             compile_expression(self.predicate, types, dicts)
             if self.predicate is not None
@@ -276,6 +299,7 @@ class FilterProjectOperator(Operator):
 
         self._compiled = (jax.jit(run), projs)
         self._compiled_dicts = dicts
+        FilterProjectOperator._PROGRAM_CACHE[key] = (self._compiled, dicts)
         return self._compiled
 
     def needs_input(self) -> bool:
@@ -405,6 +429,9 @@ class HashAggregationOperator(Operator):
         if a.fn == "avg":
             # decomposes into sum+count; dtype promotes to f64 on device
             return ("avg", data, valid, np.float64, a.distinct)
+        if a.fn in STAT_AGGS:
+            # decomposes into (sum, sum-of-squares, count) states
+            return (a.fn, data, valid, np.float64, a.distinct)
         if a.fn == "sum":
             dtype = np.float64 if out_t == DOUBLE else np.int64
             return ("sum", data, valid, dtype, a.distinct)
@@ -430,6 +457,13 @@ class HashAggregationOperator(Operator):
                                    np.zeros(1, np.float64), np.zeros(1, bool)))
                 cols.append(Column(self.output_types[i + 1], np.zeros(1, np.int64)))
                 i += 2
+                continue
+            if self.step == "PARTIAL" and a.fn in STAT_AGGS:
+                cols.append(Column(self.output_types[i],
+                                   np.zeros(1, np.float64), np.zeros(1, bool)))
+                cols.append(Column(self.output_types[i + 1], np.zeros(1, np.float64)))
+                cols.append(Column(self.output_types[i + 2], np.zeros(1, np.int64)))
+                i += 3
                 continue
             t = self.output_types[i]
             i += 1
@@ -469,9 +503,10 @@ class HashAggregationOperator(Operator):
                 return live
             return jnp.asarray(valid) & jnp.asarray(live)
 
-        # kernel specs; avg expands to (sum, count) state pairs.  FINAL
-        # merges partial states: count -> sum of counts, others same fn.
-        specs, avg_slots = [], {}
+        # kernel specs; avg expands to (sum, count) state pairs, the variance
+        # family to (sum, sumsq, count) triples.  FINAL merges partial
+        # states: count -> sum of counts, others same fn.
+        specs, avg_slots, stat_slots = [], {}, {}
         for idx, a in enumerate(self.aggs):
             if self.step == "FINAL":
                 c = inp.columns[a.arg]
@@ -481,6 +516,13 @@ class HashAggregationOperator(Operator):
                     c2 = inp.columns[a.arg + 1]
                     specs.append(("sum", data, valid, np.float64, False))
                     specs.append(("sum", c2.data, fold_live(None), np.int64, False))
+                elif a.fn in STAT_AGGS:
+                    stat_slots[idx] = len(specs)
+                    c2 = inp.columns[a.arg + 1]
+                    c3 = inp.columns[a.arg + 2]
+                    specs.append(("sum", data, valid, np.float64, False))
+                    specs.append(("sum", c2.data, fold_live(c2.valid), np.float64, False))
+                    specs.append(("sum", c3.data, fold_live(None), np.int64, False))
                 elif a.fn in ("count", "count_star"):
                     specs.append(("sum", data, fold_live(None), np.int64, False))
                 else:
@@ -496,6 +538,12 @@ class HashAggregationOperator(Operator):
                 sum_data = s[1].astype(np.float64) / (10 ** scale)
                 specs.append(("sum", sum_data, s[2], np.float64, s[4]))
                 specs.append(("count", s[1], s[2], np.int64, s[4]))
+            elif s[0] in STAT_AGGS:
+                stat_slots[idx] = len(specs)
+                x = s[1].astype(np.float64)
+                specs.append(("sum", x, s[2], np.float64, False))
+                specs.append(("sum", x * x, s[2], np.float64, False))
+                specs.append(("count", s[1], s[2], np.int64, False))
             else:
                 specs.append(s)
         reduced = K.grouped_reduce(perm, gid, num_groups, specs) if specs else []
@@ -519,6 +567,35 @@ class HashAggregationOperator(Operator):
                 cnt = jnp.maximum(jnp.asarray(c_data), 1)
                 vals = jnp.asarray(s_data) / cnt
                 valid = jnp.asarray(c_data) > 0
+                if s_valid is not None:
+                    valid = valid & jnp.asarray(s_valid)
+                out_cols.append(Column(t, vals.astype(t.storage_dtype), valid))
+                continue
+            if idx in stat_slots:
+                # variance family: combine (sum, sumsq, count) states
+                # (reference: operator/aggregation/VarianceAccumulator)
+                s_data, s_valid = reduced[ri]
+                q_data, _ = reduced[ri + 1]
+                c_data, _ = reduced[ri + 2]
+                ri += 3
+                if self.step == "PARTIAL":
+                    out_cols.append(Column(t, s_data.astype(np.float64), s_valid))
+                    out_cols.append(Column(self.output_types[len(out_cols)],
+                                           q_data.astype(np.float64)))
+                    out_cols.append(Column(self.output_types[len(out_cols)],
+                                           c_data.astype(np.int64)))
+                    continue
+                n = jnp.asarray(c_data).astype(jnp.float64)
+                safe_n = jnp.maximum(n, 1.0)
+                mean = jnp.asarray(s_data) / safe_n
+                m2 = jnp.maximum(jnp.asarray(q_data) - safe_n * mean * mean, 0.0)
+                if a.fn in ("var_pop", "stddev_pop"):
+                    var = m2 / safe_n
+                    valid = n > 0
+                else:  # sample variance: NULL for fewer than 2 values
+                    var = m2 / jnp.maximum(n - 1.0, 1.0)
+                    valid = n > 1
+                vals = jnp.sqrt(var) if a.fn.startswith("stddev") else var
                 if s_valid is not None:
                     valid = valid & jnp.asarray(s_valid)
                 out_cols.append(Column(t, vals.astype(t.storage_dtype), valid))

@@ -21,6 +21,12 @@ __all__ = [
     "day_of",
     "quarter_of",
     "add_months",
+    "day_of_week",
+    "day_of_year",
+    "trunc_year",
+    "trunc_quarter",
+    "trunc_month",
+    "trunc_week",
     "MICROS_PER_DAY",
 ]
 
@@ -64,6 +70,37 @@ def day_of(days):
 
 def quarter_of(days):
     return (civil_from_days(days)[1] + 2) // 3
+
+
+def day_of_week(days):
+    """ISO day of week: 1 = Monday .. 7 = Sunday (Trino day_of_week/dow).
+    1970-01-01 was a Thursday, so day index (days + 3) mod 7 is Monday-based."""
+    return jnp.remainder(days.astype(jnp.int64) + 3, 7) + 1
+
+
+def day_of_year(days):
+    return days.astype(jnp.int64) - trunc_year(days) + 1
+
+
+def trunc_year(days):
+    y, _, _ = civil_from_days(days)
+    return days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+
+
+def trunc_quarter(days):
+    y, m, _ = civil_from_days(days)
+    qm = ((m - 1) // 3) * 3 + 1
+    return days_from_civil(y, qm, jnp.ones_like(y))
+
+
+def trunc_month(days):
+    y, m, _ = civil_from_days(days)
+    return days_from_civil(y, m, jnp.ones_like(y))
+
+
+def trunc_week(days):
+    """Truncate to the Monday of the week."""
+    return days.astype(jnp.int64) - (day_of_week(days) - 1)
 
 
 _DAYS_IN_MONTH = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])

@@ -514,6 +514,141 @@ def _strfn_handler(pyfn, result="str"):
     return handler
 
 
+def _literal_str(x: Lowered) -> str:
+    if x.dictionary is None or len(x.dictionary) != 1:
+        raise NotImplementedError("expected string literal argument")
+    return str(x.dictionary[0])
+
+
+_CONCAT_DICT_LIMIT = 1 << 20  # max product-dictionary size for col || col
+
+
+def _concat_pair(a: Lowered, b: Lowered) -> Lowered:
+    """String concatenation in dictionary space: literal sides transform the
+    other side's dictionary; column||column builds the (small) product
+    dictionary and remaps the combined code on device."""
+    if a.dictionary is None or b.dictionary is None:
+        raise NotImplementedError("concat on non-dictionary operands")
+    if len(b.dictionary) == 1:
+        lit = str(b.dictionary[0])
+        return _dict_transform(a, lambda s: s + lit, VARCHAR)
+    if len(a.dictionary) == 1:
+        lit = str(a.dictionary[0])
+        return _dict_transform(b, lambda s: lit + s, VARCHAR)
+    na, nb = len(a.dictionary), len(b.dictionary)
+    if na * nb > _CONCAT_DICT_LIMIT:
+        raise NotImplementedError(
+            f"concat product dictionary too large ({na}x{nb})")
+    prod = np.array([str(x) + str(y) for x in a.dictionary
+                     for y in b.dictionary], dtype=object)
+    newdict, remap = np.unique(prod, return_inverse=True)
+    remap = remap.astype(np.int32)
+
+    def fn(cols: Cols):
+        (ac, av), (bc, bv) = a.fn(cols), b.fn(cols)
+        code = ac.astype(jnp.int64) * nb + bc.astype(jnp.int64)
+        return jnp.asarray(remap)[code], _and_valid(av, bv)
+
+    return Lowered(VARCHAR, newdict, fn)
+
+
+def _concat_handler(out_type, args):
+    out = args[0]
+    for nxt in args[1:]:
+        out = _concat_pair(out, nxt)
+    return out
+
+
+def _replace_handler(out_type, args):
+    col = args[0]
+    search = _literal_str(args[1])
+    rep = _literal_str(args[2]) if len(args) > 2 else ""
+    if col.dictionary is None:
+        raise NotImplementedError("replace on non-dictionary column")
+    return _dict_transform(col, lambda s: s.replace(search, rep), VARCHAR)
+
+
+def _strpos_handler(out_type, args):
+    col = args[0]
+    sub = _literal_str(args[1])
+    if col.dictionary is None:
+        raise NotImplementedError("strpos on non-dictionary column")
+    return _dict_scalar(col, lambda s: s.find(sub) + 1, BIGINT)
+
+
+def _starts_with_handler(out_type, args):
+    col = args[0]
+    prefix = _literal_str(args[1])
+    if col.dictionary is None:
+        raise NotImplementedError("starts_with on non-dictionary column")
+    arr = np.array([str(v).startswith(prefix) for v in col.dictionary])
+
+    def fn(cols: Cols):
+        codes, valid = col.fn(cols)
+        return jnp.asarray(arr)[codes], valid
+
+    return Lowered(BOOLEAN, None, fn)
+
+
+def _variadic_minmax(jfn):
+    """greatest/least: NULL if any argument is NULL (Trino semantics)."""
+
+    def handler(out_type, args):
+        def fn(cols: Cols):
+            vals, valids = zip(*[a.fn(cols) for a in args])
+            data = vals[0]
+            for v in vals[1:]:
+                data = jfn(data, v)
+            return data.astype(out_type.storage_dtype), _all_valids(valids)
+
+        return Lowered(out_type, None, fn)
+
+    return handler
+
+
+def _date_trunc_handler(truncfn):
+    """date_trunc on DATE (days) or TIMESTAMP (micros since epoch)."""
+
+    def handler(out_type, args):
+        (a,) = args
+
+        def fn(cols: Cols):
+            v, vv = a.fn(cols)
+            if a.type == TIMESTAMP:
+                days = jnp.floor_divide(v, dt.MICROS_PER_DAY)
+                return truncfn(days) * dt.MICROS_PER_DAY, vv
+            return truncfn(v).astype(out_type.storage_dtype), vv
+
+        return Lowered(out_type, None, fn)
+
+    return handler
+
+
+def _const_handler(value):
+    def handler(out_type, args):
+        def fn(cols: Cols):
+            return jnp.asarray(value, dtype=out_type.storage_dtype), None
+
+        return Lowered(out_type, None, fn)
+
+    return handler
+
+
+def _truncate_handler(out_type, args):
+    (a,) = args
+
+    def fn(cols: Cols):
+        v, vv = a.fn(cols)
+        if isinstance(a.type, DecimalType):
+            f = 10 ** a.type.scale
+            return _trunc_div(v, f) * f, vv
+        if np.issubdtype(v.dtype, np.integer):
+            return v, vv
+        return jnp.trunc(v), vv
+
+    return Lowered(out_type, None, fn)
+
+
 # ---------------------------------------------------------------------------
 # CAST
 
@@ -637,6 +772,38 @@ HANDLERS: dict[str, Callable] = {
     "ltrim": _strfn_handler(str.lstrip),
     "rtrim": _strfn_handler(str.rstrip),
     "length": _strfn_handler(len, result="scalar"),
+    "reverse": _strfn_handler(lambda s: s[::-1]),
+    "concat": _concat_handler,
+    "replace": _replace_handler,
+    "strpos": _strpos_handler,
+    "starts_with": _starts_with_handler,
+    "greatest": _variadic_minmax(jnp.maximum),
+    "least": _variadic_minmax(jnp.minimum),
+    "sign": _elementwise(jnp.sign),
+    "truncate": _truncate_handler,
+    "cbrt": _elementwise(jnp.cbrt),
+    "degrees": _elementwise(jnp.degrees),
+    "radians": _elementwise(jnp.radians),
+    "sin": _elementwise(jnp.sin),
+    "cos": _elementwise(jnp.cos),
+    "tan": _elementwise(jnp.tan),
+    "asin": _elementwise(jnp.arcsin),
+    "acos": _elementwise(jnp.arccos),
+    "atan": _elementwise(jnp.arctan),
+    "atan2": _elementwise(jnp.arctan2),
+    "log2": _elementwise(jnp.log2),
+    "pi": _const_handler(np.pi),
+    "e": _const_handler(np.e),
+    "is_nan": _elementwise(jnp.isnan),
+    "day_of_week": _elementwise(dt.day_of_week),
+    "dow": _elementwise(dt.day_of_week),
+    "day_of_year": _elementwise(dt.day_of_year),
+    "doy": _elementwise(dt.day_of_year),
+    "date_trunc_year": _date_trunc_handler(dt.trunc_year),
+    "date_trunc_quarter": _date_trunc_handler(dt.trunc_quarter),
+    "date_trunc_month": _date_trunc_handler(dt.trunc_month),
+    "date_trunc_week": _date_trunc_handler(dt.trunc_week),
+    "date_trunc_day": _date_trunc_handler(lambda d: d),
 }
 
 

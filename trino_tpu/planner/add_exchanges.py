@@ -53,10 +53,15 @@ def partial_agg_layout(aggs, input_types) -> list[tuple[str, Type, int]]:
     """Per original AggCall: list of (state_fn, state_type, width) describing
     the PARTIAL output columns.  avg → [(sum,f64),(count,i64)] with the
     decimal scale folded into the sum state."""
+    from ..sql.analyzer import STAT_AGGS
+
     out = []
     for a in aggs:
         if a.fn == "avg":
             out.append([("avg_sum", DOUBLE), ("avg_count", BIGINT)])
+        elif a.fn in STAT_AGGS:
+            out.append([("stat_sum", DOUBLE), ("stat_sumsq", DOUBLE),
+                        ("stat_count", BIGINT)])
         elif a.fn == "count":
             out.append([("count", BIGINT)])
         else:

@@ -352,8 +352,20 @@ class LogicalPlanner:
 
         has_aggs = bool(collector.calls)
         if has_group or has_aggs:
+            # GROUP BY <ordinal> resolves to the select item's expression
+            # (SqlBase.g4 groupBy -> expression; ordinal handling mirrors
+            # StatementAnalyzer.analyzeGroupBy)
+            group_asts = []
+            for g in spec.group_by:
+                if isinstance(g, ast.IntLiteral):
+                    if not 1 <= g.value <= len(select_items):
+                        raise AnalysisError(
+                            f"GROUP BY position {g.value} is not in select list")
+                    group_asts.append(select_items[g.value - 1].expr)
+                else:
+                    group_asts.append(g)
             group_irs = [Translator(rel.scope(outer)).translate(g)
-                         for g in spec.group_by]
+                         for g in group_asts]
             rel, rewrite = self._plan_aggregation(rel, group_irs, collector, outer)
 
             # validate BEFORE rewriting: every select subtree must be a

@@ -250,14 +250,46 @@ class ColumnBatch:
             return self.num_rows
         return int(np.asarray(self.live).sum())
 
+    def to_host(self) -> "ColumnBatch":
+        """Materialize every device array with ONE jax.device_get round trip.
+
+        Per-array np.asarray costs a full device round trip each (~100ms over
+        a tunneled TPU); batching the transfer makes the host boundary one
+        round trip per batch instead of one per column."""
+        pending = []
+        for c in self.columns:
+            if not isinstance(c.data, np.ndarray):
+                pending.append(c.data)
+            if c.valid is not None and not isinstance(c.valid, np.ndarray):
+                pending.append(c.valid)
+        if self.live is not None and not isinstance(self.live, np.ndarray):
+            pending.append(self.live)
+        if not pending:
+            return self
+        import jax
+
+        fetched = iter(jax.device_get(pending))
+        cols = []
+        for c in self.columns:
+            d = c.data if isinstance(c.data, np.ndarray) else next(fetched)
+            v = c.valid
+            if v is not None and not isinstance(v, np.ndarray):
+                v = next(fetched)
+            cols.append(Column(c.type, d, v, c.dictionary))
+        live = self.live
+        if live is not None and not isinstance(live, np.ndarray):
+            live = next(fetched)
+        return ColumnBatch(self.names, cols, live)
+
     def compact(self) -> "ColumnBatch":
         """Densify: drop dead rows, return a host-side batch without live."""
-        if self.live is None:
-            return self
-        mask = np.asarray(self.live)
+        dense = self.to_host()
+        if dense.live is None:
+            return dense
+        mask = np.asarray(dense.live)
         if mask.all():
-            return ColumnBatch(self.names, self.columns)
-        return ColumnBatch(self.names, [c.filter(mask) for c in self.columns])
+            return ColumnBatch(dense.names, dense.columns)
+        return ColumnBatch(dense.names, [c.filter(mask) for c in dense.columns])
 
     @property
     def num_columns(self) -> int:

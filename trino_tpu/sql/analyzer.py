@@ -48,7 +48,17 @@ class AnalysisError(ValueError):
     pass
 
 
-AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "any_value"}
+AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "any_value",
+                 "stddev", "stddev_samp", "stddev_pop",
+                 "variance", "var_samp", "var_pop"}
+
+# aggregates rewritten onto the core set during translation
+_AGG_ALIASES = {"arbitrary": "any_value", "bool_and": "min", "every": "min",
+                "bool_or": "max"}
+
+# the 3-state (sum, sum-of-squares, count) family
+STAT_AGGS = {"stddev", "stddev_samp", "stddev_pop",
+             "variance", "var_samp", "var_pop"}
 
 # pure window (ranking/navigation) functions; aggregates are also legal
 # with an OVER clause (reference: sql/analyzer/ExpressionAnalyzer window
@@ -65,9 +75,18 @@ _SCALAR_TYPES: dict[str, str] = {
     "power": "double", "pow": "double",
     "floor": "arg", "ceiling": "arg", "ceil": "arg",
     "year": "bigint", "month": "bigint", "day": "bigint", "quarter": "bigint",
-    "length": "bigint",
+    "day_of_week": "bigint", "dow": "bigint", "day_of_year": "bigint",
+    "doy": "bigint",
+    "length": "bigint", "strpos": "bigint",
     "substring": "varchar", "substr": "varchar", "upper": "varchar",
     "lower": "varchar", "trim": "varchar", "ltrim": "varchar", "rtrim": "varchar",
+    "reverse": "varchar", "concat": "varchar", "replace": "varchar",
+    "starts_with": "boolean", "is_nan": "boolean",
+    "truncate": "arg",
+    "cbrt": "double", "degrees": "double", "radians": "double",
+    "sin": "double", "cos": "double", "tan": "double",
+    "asin": "double", "acos": "double", "atan": "double", "atan2": "double",
+    "log2": "double", "pi": "double", "e": "double",
 }
 
 
@@ -164,7 +183,7 @@ class WindowCollector:
 def agg_result_type(fn: str, arg_type: Optional[Type]) -> Type:
     if fn == "count":
         return BIGINT
-    if fn == "avg":
+    if fn == "avg" or fn in STAT_AGGS:
         return DOUBLE
     if fn == "sum":
         if isinstance(arg_type, DecimalType):
@@ -280,7 +299,11 @@ class Translator:
 
     def _t_BinaryOp(self, e: ast.BinaryOp) -> RowExpression:
         if e.op == "||":
-            raise AnalysisError("|| concat not yet supported")
+            left = self.translate(e.left)
+            right = self.translate(e.right)
+            if not (is_string(left.type) and is_string(right.type)):
+                raise AnalysisError("|| requires varchar operands")
+            return Call(VARCHAR, "concat", (left, right))
         # date +- interval
         if isinstance(e.right, ast.IntervalLiteral):
             left = self.translate(e.left)
@@ -464,6 +487,9 @@ class Translator:
             return self._t_window_call(e)
         if name in WINDOW_FUNCTIONS:
             raise AnalysisError(f"{name} requires an OVER clause")
+        if name in _AGG_ALIASES or name in ("approx_distinct", "count_if",
+                                            "geometric_mean"):
+            return self._t_agg_special(e, name)
         if name in AGG_FUNCTIONS or (name == "count" and e.is_star):
             if self.aggregates is None:
                 raise AnalysisError(f"aggregate {name} not allowed here")
@@ -473,12 +499,49 @@ class Translator:
                 idx = self.aggregates.add("count", None, False, BIGINT)
                 return Call(BIGINT, "$aggref", (Literal(BIGINT, idx),))
             arg = self.translate(e.args[0])
+            if name in STAT_AGGS:
+                if e.distinct:
+                    raise AnalysisError(f"DISTINCT {name} not supported")
+                arg = cast_to(arg, DOUBLE)
             out_t = agg_result_type(name, arg.type)
             idx = self.aggregates.add(name, arg, e.distinct, out_t)
             return Call(out_t, "$aggref", (Literal(BIGINT, idx),))
         if name == "coalesce":
             return self._t_coalesce(e)
         return self._t_scalar_call(e)
+
+    def _t_agg_special(self, e: ast.FunctionCall, name: str) -> RowExpression:
+        """Aggregates that rewrite onto the core set (reference: these are
+        standalone AccumulatorFactories in operator/aggregation/; here
+        bool_and = min over booleans, approx_distinct = exact distinct count
+        (zero-error 'approximation'), count_if = count over a nullable
+        marker, geometric_mean = exp(avg(ln x)))."""
+        if self.aggregates is None:
+            raise AnalysisError(f"aggregate {name} not allowed here")
+        if name in _AGG_ALIASES:
+            core = _AGG_ALIASES[name]
+            arg = self.translate(e.args[0])
+            if name in ("bool_and", "bool_or", "every"):
+                arg = cast_to(arg, BOOLEAN)
+            out_t = agg_result_type(core, arg.type)
+            idx = self.aggregates.add(core, arg, e.distinct, out_t)
+            return Call(out_t, "$aggref", (Literal(BIGINT, idx),))
+        if name == "approx_distinct":
+            arg = self.translate(e.args[0])
+            idx = self.aggregates.add("count", arg, True, BIGINT)
+            return Call(BIGINT, "$aggref", (Literal(BIGINT, idx),))
+        if name == "count_if":
+            cond = cast_to(self.translate(e.args[0]), BOOLEAN)
+            marker = Call(BIGINT, "$if",
+                          (cond, Literal(BIGINT, 1), Literal(BIGINT, None)))
+            idx = self.aggregates.add("count", marker, False, BIGINT)
+            return Call(BIGINT, "$aggref", (Literal(BIGINT, idx),))
+        # geometric_mean
+        arg = cast_to(self.translate(e.args[0]), DOUBLE)
+        idx = self.aggregates.add("avg", Call(DOUBLE, "ln", (arg,)), False,
+                                  DOUBLE)
+        return Call(DOUBLE, "exp",
+                    (Call(DOUBLE, "$aggref", (Literal(BIGINT, idx),)),))
 
     def _t_coalesce(self, e: ast.FunctionCall) -> RowExpression:
         args = [self.translate(a) for a in e.args]
@@ -492,6 +555,45 @@ class Translator:
 
     def _t_scalar_call(self, e: ast.FunctionCall) -> RowExpression:
         name = e.name.lower()
+        if name == "mod":
+            return self._t_BinaryOp(ast.BinaryOp("%", e.args[0], e.args[1]))
+        if name == "if":
+            cond = cast_to(self.translate(e.args[0]), BOOLEAN)
+            t = self.translate(e.args[1])
+            f = (self.translate(e.args[2]) if len(e.args) > 2
+                 else Literal(UNKNOWN, None))
+            common = common_super_type(t.type, f.type)
+            if common is None or common == UNKNOWN:
+                raise AnalysisError("IF branch types differ")
+            return Call(common, "$if",
+                        (cond, cast_to(t, common), cast_to(f, common)))
+        if name == "date_trunc":
+            if not isinstance(e.args[0], ast.StringLiteral):
+                raise AnalysisError("date_trunc unit must be a string literal")
+            unit = e.args[0].value.lower()
+            if unit not in ("year", "quarter", "month", "week", "day"):
+                raise AnalysisError(f"date_trunc unit not supported: {unit}")
+            operand = self.translate(e.args[1])
+            if operand.type not in (DATE, TIMESTAMP):
+                raise AnalysisError("date_trunc requires a date or timestamp")
+            return Call(operand.type, f"date_trunc_{unit}", (operand,))
+        if name in ("greatest", "least"):
+            args = [self.translate(a) for a in e.args]
+            if any(is_string(a.type) for a in args):
+                raise AnalysisError(
+                    f"{name} over varchar not supported (dictionary codes "
+                    "have no cross-column order)")
+            common = args[0].type
+            for a in args[1:]:
+                c = common_super_type(common, a.type)
+                if c is None:
+                    raise AnalysisError(f"{name} argument types differ")
+                common = c
+            return Call(common, name, tuple(cast_to(a, common) for a in args))
+        if name == "sign":
+            a = self.translate(e.args[0])
+            out = DOUBLE if a.type == DOUBLE else BIGINT
+            return Call(out, "sign", (a,))
         if name == "nullif":
             a = self.translate(e.args[0])
             b = self.translate(e.args[1])
@@ -509,6 +611,8 @@ class Translator:
             args = tuple(cast_to(a, DOUBLE) for a in args)
         elif rule == "bigint":
             out_t = BIGINT
+        elif rule == "boolean":
+            out_t = BOOLEAN
         else:
             out_t = VARCHAR
         return Call(out_t, name, args)
@@ -581,6 +685,8 @@ class Translator:
         elif name in AGG_FUNCTIONS:
             if e.distinct:
                 raise AnalysisError("DISTINCT window aggregates not supported")
+            if name in STAT_AGGS:
+                raise AnalysisError(f"{name} OVER (...) not supported yet")
             arg = self.translate(e.args[0])
             if name == "avg":
                 out_t = DOUBLE

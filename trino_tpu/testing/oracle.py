@@ -103,7 +103,81 @@ def transpile(sql: str) -> str:
     out = re.sub(r"(?is)extract\s*\(\s*month\s+from\s+", "tpch_month(", out)
     out = re.sub(r"(?is)extract\s*\(\s*quarter\s+from\s+", "tpch_quarter(", out)
     out = re.sub(r"(?i)\bsubstring\s*\(", "substr(", out)
+    out = re.sub(r"(?i)\bgreatest\s*\(", "max(", out)
+    out = re.sub(r"(?i)\bleast\s*\(", "min(", out)
+    out = re.sub(r"(?i)\bif\s*\(", "iif(", out)
     return out
+
+
+class _VarAgg:
+    """Aggregate UDF for the variance/stddev family (matches Trino's
+    VarianceAccumulator semantics: *_samp NULL below 2 rows, *_pop 0 for 1)."""
+
+    kind = "var_samp"
+
+    def __init__(self):
+        self.n = 0
+        self.s = 0.0
+        self.q = 0.0
+
+    def step(self, v):
+        if v is None:
+            return
+        v = float(v)
+        self.n += 1
+        self.s += v
+        self.q += v * v
+
+    def finalize(self):
+        if self.n == 0:
+            return None
+        m2 = max(self.q - self.s * self.s / self.n, 0.0)
+        if self.kind in ("var_pop", "stddev_pop"):
+            var = m2 / self.n
+        else:
+            if self.n < 2:
+                return None
+            var = m2 / (self.n - 1)
+        return math.sqrt(var) if self.kind.startswith("stddev") else var
+
+
+def _var_agg(kind_name):
+    return type(f"_Agg_{kind_name}", (_VarAgg,), {"kind": kind_name})
+
+
+class _BoolAgg:
+    all_mode = True
+
+    def __init__(self):
+        self.acc = None
+
+    def step(self, v):
+        if v is None:
+            return
+        b = bool(v)
+        self.acc = b if self.acc is None else (
+            (self.acc and b) if self.all_mode else (self.acc or b))
+
+    def finalize(self):
+        return None if self.acc is None else int(self.acc)
+
+
+def _date_trunc(unit, days):
+    if days is None:
+        return None
+    d = _EPOCH + datetime.timedelta(days=days)
+    u = unit.lower()
+    if u == "year":
+        t = datetime.date(d.year, 1, 1)
+    elif u == "quarter":
+        t = datetime.date(d.year, ((d.month - 1) // 3) * 3 + 1, 1)
+    elif u == "month":
+        t = datetime.date(d.year, d.month, 1)
+    elif u == "week":
+        t = d - datetime.timedelta(days=d.weekday())
+    else:
+        t = d
+    return (t - _EPOCH).days
 
 
 class SqliteOracle:
@@ -113,6 +187,55 @@ class SqliteOracle:
         self.db.create_function("tpch_year", 1, _year, deterministic=True)
         self.db.create_function("tpch_month", 1, _month, deterministic=True)
         self.db.create_function("tpch_quarter", 1, _quarter, deterministic=True)
+        for k in ("stddev", "stddev_samp", "stddev_pop",
+                  "variance", "var_samp", "var_pop"):
+            self.db.create_aggregate(k, 1, _var_agg(k))
+        self.db.create_aggregate(
+            "bool_and", 1, type("_BA", (_BoolAgg,), {"all_mode": True}))
+        self.db.create_aggregate(
+            "bool_or", 1, type("_BO", (_BoolAgg,), {"all_mode": False}))
+        self.db.create_function("date_trunc", 2, _date_trunc, deterministic=True)
+        self.db.create_function(
+            "day_of_week", 1,
+            lambda d: None if d is None else
+            (_EPOCH + datetime.timedelta(days=d)).isoweekday(),
+            deterministic=True)
+        self.db.create_function(
+            "day_of_year", 1,
+            lambda d: None if d is None else
+            (_EPOCH + datetime.timedelta(days=d)).timetuple().tm_yday,
+            deterministic=True)
+        self.db.create_function(
+            "strpos", 2,
+            lambda s, sub: None if s is None or sub is None else s.find(sub) + 1,
+            deterministic=True)
+        self.db.create_function(
+            "starts_with", 2,
+            lambda s, p: None if s is None or p is None else int(s.startswith(p)),
+            deterministic=True)
+        self.db.create_function(
+            "reverse", 1, lambda s: None if s is None else s[::-1],
+            deterministic=True)
+        self.db.create_function(
+            "concat", -1,
+            lambda *a: None if any(x is None for x in a) else
+            "".join(str(x) for x in a),
+            deterministic=True)
+        self.db.create_function(
+            "sign", 1,
+            lambda v: None if v is None else (v > 0) - (v < 0),
+            deterministic=True)
+        self.db.create_function(
+            "mod", 2,
+            lambda a, b: None if a is None or b is None or b == 0 else
+            math.fmod(a, b) if isinstance(a, float) or isinstance(b, float)
+            else int(math.fmod(a, b)),
+            deterministic=True)
+        self.db.create_aggregate("count_if", 1, type("_CI", (), {
+            "__init__": lambda s: setattr(s, "n", 0),
+            "step": lambda s, v: setattr(s, "n", s.n + bool(v)),
+            "finalize": lambda s: s.n,
+        }))
 
     def load_table(self, name: str, batches: Iterable[ColumnBatch]) -> None:
         batches = list(batches)

@@ -1,0 +1,46 @@
+"""EXPLAIN / EXPLAIN ANALYZE / SHOW statements (reference:
+operator/ExplainAnalyzeOperator.java, sql/planner/planprinter/PlanPrinter)."""
+
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import StandaloneQueryRunner
+
+
+def _text(result):
+    return "\n".join(r[0] for r in result.rows())
+
+
+def test_explain_plan_text():
+    r = StandaloneQueryRunner()
+    out = _text(r.execute("explain select n_name from nation where n_regionkey = 1"))
+    assert "TableScan" in out and "Output" in out
+    assert "ms" not in out  # no timings without ANALYZE
+
+
+def test_explain_analyze_standalone():
+    r = StandaloneQueryRunner()
+    out = _text(r.execute(
+        "explain analyze select n_regionkey, count(*) from nation "
+        "group by n_regionkey"))
+    assert "Aggregate" in out
+    assert "HashAggregationOperator" in out
+    assert "total:" in out
+    assert "out 5 rows" in out  # 5 region groups
+
+
+def test_explain_analyze_distributed():
+    d = DistributedQueryRunner(worker_count=2)
+    out = _text(d.execute(
+        "explain analyze select n_regionkey, count(*) from nation "
+        "group by n_regionkey"))
+    assert "Fragment" in out
+    assert "fragment 0 task 0" in out
+    assert "RemoteExchangeSourceOperator" in out
+
+
+def test_show_tables_and_columns():
+    r = StandaloneQueryRunner()
+    tables = [row[0] for row in r.execute("show tables").rows()]
+    assert "nation" in tables and "lineitem" in tables
+    cols = _text(r.execute("show columns from nation"))
+    assert "n_nationkey bigint" in cols
+    assert "n_name varchar" in cols

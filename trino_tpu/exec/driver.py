@@ -12,21 +12,41 @@ LookupSourceFactory future).
 
 from __future__ import annotations
 
-from typing import Sequence
+import time
+from typing import Optional, Sequence
 
 from .operators import Operator
+from .stats import OperatorStats, PipelineStats, QueryStats
 
 __all__ = ["Driver", "run_pipelines"]
 
 
 class Driver:
-    def __init__(self, operators: Sequence[Operator]):
+    def __init__(self, operators: Sequence[Operator],
+                 stats: Optional[PipelineStats] = None):
         assert operators, "empty pipeline"
         self.operators = list(operators)
+        self.stats = stats
+        if stats is not None:
+            stats.operators.extend(
+                OperatorStats(type(op).__name__) for op in self.operators)
+
+    def _emit(self, i: int, page) -> None:
+        """Credit a page moving from operator i to i+1."""
+        s = self.stats
+        if s is None or page is None:
+            return
+        src, dst = s.operators[i], s.operators[i + 1]
+        src.output_rows += page.num_rows
+        src.output_batches += 1
+        dst.input_rows += page.num_rows
+        dst.input_batches += 1
 
     def run(self) -> None:
         ops = self.operators
         n = len(ops)
+        timed = self.stats is not None
+        st = self.stats.operators if timed else None
         while not ops[-1].is_finished():
             progressed = False
             for i in range(n - 1):
@@ -37,12 +57,22 @@ class Driver:
                     progressed = True
                     continue
                 if not cur.is_finished() and nxt.needs_input():
+                    t0 = time.perf_counter() if timed else 0.0
                     page = cur.get_output()
+                    if timed:
+                        st[i].wall_s += time.perf_counter() - t0
                     if page is not None:
+                        t0 = time.perf_counter() if timed else 0.0
                         nxt.add_input(page)
+                        if timed:
+                            st[i + 1].wall_s += time.perf_counter() - t0
+                        self._emit(i, page)
                         progressed = True
                 if cur.is_finished() and not nxt.input_done:
+                    t0 = time.perf_counter() if timed else 0.0
                     nxt.finish_input()
+                    if timed:
+                        st[i + 1].wall_s += time.perf_counter() - t0
                     progressed = True
             if ops[-1].is_finished():
                 break
@@ -55,7 +85,12 @@ class Driver:
                 op.close()
 
 
-def run_pipelines(pipelines: Sequence[Sequence[Operator]]) -> None:
+def run_pipelines(pipelines: Sequence[Sequence[Operator]],
+                  stats: Optional[QueryStats] = None) -> None:
     """Execute pipelines in dependency order (build sides first)."""
     for p in pipelines:
-        Driver(p).run()
+        ps = None
+        if stats is not None:
+            ps = PipelineStats()
+            stats.pipelines.append(ps)
+        Driver(p, ps).run()

@@ -20,6 +20,7 @@ moves fixed-shape column arrays in and out of those programs.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -227,7 +228,9 @@ class FilterProjectOperator(Operator):
     # a fresh closure per run would recompile every time (~0.5-0.8s per
     # program on a tunneled TPU).  Values hold their dictionary arrays so the
     # id()-based key component can never be recycled by the allocator.
+    # Guarded by a lock: distributed worker threads share this cache.
     _PROGRAM_CACHE: dict = {}
+    _PROGRAM_CACHE_LOCK = threading.Lock()
 
     def __init__(self, predicate: Optional[RowExpression],
                  projections: Optional[Sequence[RowExpression]],
@@ -255,12 +258,13 @@ class FilterProjectOperator(Operator):
             tuple(self.output_types),
         )
         cache = FilterProjectOperator._PROGRAM_CACHE
-        hit = cache.get(key)
-        if hit is not None:
-            self._compiled, self._compiled_dicts = hit[0], dicts
-            return self._compiled
-        if len(cache) >= 1024:  # bound: evict oldest (dict = insertion order)
-            cache.pop(next(iter(cache)))
+        with FilterProjectOperator._PROGRAM_CACHE_LOCK:
+            hit = cache.get(key)
+            if hit is not None:
+                self._compiled, self._compiled_dicts = hit[0], dicts
+                return self._compiled
+            if len(cache) >= 1024:  # bound: evict oldest (insertion order)
+                cache.pop(next(iter(cache)))
         pred = (
             compile_expression(self.predicate, types, dicts)
             if self.predicate is not None
@@ -299,7 +303,9 @@ class FilterProjectOperator(Operator):
 
         self._compiled = (jax.jit(run), projs)
         self._compiled_dicts = dicts
-        FilterProjectOperator._PROGRAM_CACHE[key] = (self._compiled, dicts)
+        with FilterProjectOperator._PROGRAM_CACHE_LOCK:
+            FilterProjectOperator._PROGRAM_CACHE.setdefault(
+                key, (self._compiled, dicts))
         return self._compiled
 
     def needs_input(self) -> bool:

@@ -1,0 +1,50 @@
+"""Per-operator execution statistics (the OperatorStats equivalent).
+
+Mirrors the role of operator/OperatorStats.java + OperationTimer: the Driver
+credits wall time and row/batch counts to each operator as it moves pages, and
+EXPLAIN ANALYZE renders the totals per pipeline (reference:
+operator/ExplainAnalyzeOperator.java:36, sql/planner/planprinter/PlanPrinter).
+
+Row counts use physical batch rows (padded slots included) so collecting
+stats never forces a device sync on the hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OperatorStats:
+    name: str
+    input_rows: int = 0
+    output_rows: int = 0
+    input_batches: int = 0
+    output_batches: int = 0
+    wall_s: float = 0.0
+
+
+@dataclass
+class PipelineStats:
+    operators: list[OperatorStats] = field(default_factory=list)
+
+
+@dataclass
+class QueryStats:
+    """One query's (or one task's) operator stats, per pipeline."""
+
+    label: str = ""
+    pipelines: list[PipelineStats] = field(default_factory=list)
+
+    def text(self) -> str:
+        lines = []
+        if self.label:
+            lines.append(self.label)
+        for i, p in enumerate(self.pipelines):
+            lines.append(f"  pipeline {i}:")
+            for op in p.operators:
+                lines.append(
+                    f"    {op.name}: {op.wall_s * 1e3:.1f} ms, "
+                    f"in {op.input_rows} rows/{op.input_batches} batches, "
+                    f"out {op.output_rows} rows/{op.output_batches} batches")
+        return "\n".join(lines)

@@ -22,12 +22,14 @@ from typing import Optional
 from ..connectors.catalog import Catalog, default_catalog
 from ..exec.driver import run_pipelines
 from ..exec.local_planner import LocalPlanner
+from ..exec.stats import QueryStats
 from ..planner.add_exchanges import add_exchanges
 from ..planner.logical import LogicalPlanner
 from ..planner.optimizer import optimize
 from ..planner.plan import PlanNode
-from ..runner import QueryResult, Session
+from ..runner import QueryResult, Session, text_result
 from ..spi.batch import Column, ColumnBatch
+from ..sql import ast
 from ..sql.parser import parse_statement
 from .exchange import ExchangeClient, OutputBuffer
 from .fragmenter import PlanFragment, SubPlan, fragment_plan
@@ -54,7 +56,9 @@ class DistributedQueryRunner:
 
     # ------------------------------------------------------------------ plan
     def create_plan(self, sql: str) -> PlanNode:
-        stmt = parse_statement(sql)
+        return self._plan_stmt(parse_statement(sql))
+
+    def _plan_stmt(self, stmt: ast.Statement) -> PlanNode:
         plan = LogicalPlanner(self.catalog, self.session.default_catalog).plan(stmt)
         plan = optimize(plan, self.catalog)
         return add_exchanges(plan)
@@ -67,7 +71,29 @@ class DistributedQueryRunner:
 
     # --------------------------------------------------------------- execute
     def execute(self, sql: str) -> QueryResult:
-        subplan = self.create_subplan(sql)
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain):
+            subplan = fragment_plan(self._plan_stmt(stmt.statement))
+            lines = subplan.text().splitlines()
+            if stmt.analyze:
+                stats: list[QueryStats] = []
+                self._execute_subplan(subplan, stats)
+                for s in sorted(stats, key=lambda s: s.label):
+                    lines.extend(s.text().splitlines())
+            return text_result("Query Plan", lines)
+        if isinstance(stmt, ast.ShowTables):
+            conn = self.catalog.connector(self.session.default_catalog)
+            return text_result("Table", conn.list_tables())
+        if isinstance(stmt, ast.ShowColumns):
+            cat, table, schema = self.catalog.resolve_table(
+                stmt.table, self.session.default_catalog)
+            return text_result(
+                "Column", [f"{c.name} {c.type}" for c in schema.columns])
+        subplan = fragment_plan(self._plan_stmt(stmt))
+        return self._execute_subplan(subplan, None)
+
+    def _execute_subplan(self, subplan: SubPlan,
+                         stats_sink: Optional[list]) -> QueryResult:
         fragments = subplan.all_fragments()
 
         stages: dict[int, _Stage] = {}
@@ -93,7 +119,7 @@ class DistributedQueryRunner:
             for t in range(stage.task_count):
                 th = threading.Thread(
                     target=self._run_task,
-                    args=(stage, t, stages, errors),
+                    args=(stage, t, stages, errors, stats_sink),
                     name=f"task-{f.id}.{t}",
                     daemon=True,
                 )
@@ -131,7 +157,8 @@ class DistributedQueryRunner:
         return QueryResult(names, batch)
 
     def _run_task(self, stage: _Stage, task_index: int,
-                  stages: dict[int, "_Stage"], errors: list) -> None:
+                  stages: dict[int, "_Stage"], errors: list,
+                  stats_sink: Optional[list] = None) -> None:
         try:
             f = stage.fragment
             clients = {
@@ -153,7 +180,12 @@ class DistributedQueryRunner:
                 f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
                 f.output_keys)
             local.pipelines[-1][-1] = sink
-            run_pipelines(local.pipelines)
+            stats = None
+            if stats_sink is not None:
+                stats = QueryStats(
+                    label=f"fragment {f.id} task {task_index}:")
+                stats_sink.append(stats)  # list.append is thread-safe
+            run_pipelines(local.pipelines, stats)
         except BaseException as e:  # noqa: BLE001 — surfaced to coordinator
             errors.append(e)
             # unblock every sibling immediately: producers stuck in enqueue

@@ -520,6 +520,24 @@ def _literal_str(x: Lowered) -> str:
     return str(x.dictionary[0])
 
 
+def _and_extra_valid(base: Lowered, extras: Sequence[Lowered]) -> Lowered:
+    """AND additional operands' validity into a result whose value was
+    computed from their dictionaries alone (a NULL literal lowers to
+    dictionary [""] + valid=False — the value shortcut must not drop it)."""
+    extras = [x for x in extras if x is not None]
+    if not extras:
+        return base
+
+    def fn(cols: Cols):
+        d, v = base.fn(cols)
+        for x in extras:
+            _, xv = x.fn(cols)
+            v = _and_valid(v, xv)
+        return d, v
+
+    return Lowered(base.type, base.dictionary, fn)
+
+
 _CONCAT_DICT_LIMIT = 1 << 20  # max product-dictionary size for col || col
 
 
@@ -531,10 +549,12 @@ def _concat_pair(a: Lowered, b: Lowered) -> Lowered:
         raise NotImplementedError("concat on non-dictionary operands")
     if len(b.dictionary) == 1:
         lit = str(b.dictionary[0])
-        return _dict_transform(a, lambda s: s + lit, VARCHAR)
+        return _and_extra_valid(
+            _dict_transform(a, lambda s: s + lit, VARCHAR), [b])
     if len(a.dictionary) == 1:
         lit = str(a.dictionary[0])
-        return _dict_transform(b, lambda s: lit + s, VARCHAR)
+        return _and_extra_valid(
+            _dict_transform(b, lambda s: lit + s, VARCHAR), [a])
     na, nb = len(a.dictionary), len(b.dictionary)
     if na * nb > _CONCAT_DICT_LIMIT:
         raise NotImplementedError(
@@ -565,7 +585,9 @@ def _replace_handler(out_type, args):
     rep = _literal_str(args[2]) if len(args) > 2 else ""
     if col.dictionary is None:
         raise NotImplementedError("replace on non-dictionary column")
-    return _dict_transform(col, lambda s: s.replace(search, rep), VARCHAR)
+    return _and_extra_valid(
+        _dict_transform(col, lambda s: s.replace(search, rep), VARCHAR),
+        args[1:])
 
 
 def _strpos_handler(out_type, args):
@@ -573,7 +595,8 @@ def _strpos_handler(out_type, args):
     sub = _literal_str(args[1])
     if col.dictionary is None:
         raise NotImplementedError("strpos on non-dictionary column")
-    return _dict_scalar(col, lambda s: s.find(sub) + 1, BIGINT)
+    return _and_extra_valid(
+        _dict_scalar(col, lambda s: s.find(sub) + 1, BIGINT), args[1:])
 
 
 def _starts_with_handler(out_type, args):
@@ -587,7 +610,7 @@ def _starts_with_handler(out_type, args):
         codes, valid = col.fn(cols)
         return jnp.asarray(arr)[codes], valid
 
-    return Lowered(BOOLEAN, None, fn)
+    return _and_extra_valid(Lowered(BOOLEAN, None, fn), args[1:])
 
 
 def _variadic_minmax(jfn):
@@ -618,6 +641,23 @@ def _date_trunc_handler(truncfn):
                 days = jnp.floor_divide(v, dt.MICROS_PER_DAY)
                 return truncfn(days) * dt.MICROS_PER_DAY, vv
             return truncfn(v).astype(out_type.storage_dtype), vv
+
+        return Lowered(out_type, None, fn)
+
+    return handler
+
+
+def _days_field_handler(field_fn):
+    """Calendar field extraction over DATE (days) or TIMESTAMP (micros)."""
+
+    def handler(out_type, args):
+        (a,) = args
+
+        def fn(cols: Cols):
+            v, vv = a.fn(cols)
+            if a.type == TIMESTAMP:
+                v = jnp.floor_divide(v, dt.MICROS_PER_DAY)
+            return field_fn(v).astype(out_type.storage_dtype), vv
 
         return Lowered(out_type, None, fn)
 
@@ -759,10 +799,10 @@ HANDLERS: dict[str, Callable] = {
     "power": _elementwise(jnp.power),
     "pow": _elementwise(jnp.power),
     "round": _round_handler,
-    "year": _elementwise(dt.year_of),
-    "month": _elementwise(dt.month_of),
-    "day": _elementwise(dt.day_of),
-    "quarter": _elementwise(dt.quarter_of),
+    "year": _days_field_handler(dt.year_of),
+    "month": _days_field_handler(dt.month_of),
+    "day": _days_field_handler(dt.day_of),
+    "quarter": _days_field_handler(dt.quarter_of),
     "add_months": _elementwise(dt.add_months),
     "substring": _substring_handler,
     "substr": _substring_handler,
@@ -795,10 +835,10 @@ HANDLERS: dict[str, Callable] = {
     "pi": _const_handler(np.pi),
     "e": _const_handler(np.e),
     "is_nan": _elementwise(jnp.isnan),
-    "day_of_week": _elementwise(dt.day_of_week),
-    "dow": _elementwise(dt.day_of_week),
-    "day_of_year": _elementwise(dt.day_of_year),
-    "doy": _elementwise(dt.day_of_year),
+    "day_of_week": _days_field_handler(dt.day_of_week),
+    "dow": _days_field_handler(dt.day_of_week),
+    "day_of_year": _days_field_handler(dt.day_of_year),
+    "doy": _days_field_handler(dt.day_of_year),
     "date_trunc_year": _date_trunc_handler(dt.trunc_year),
     "date_trunc_quarter": _date_trunc_handler(dt.trunc_quarter),
     "date_trunc_month": _date_trunc_handler(dt.trunc_month),

@@ -16,13 +16,21 @@ import numpy as np
 from .connectors.catalog import Catalog, default_catalog
 from .exec.driver import run_pipelines
 from .exec.local_planner import LocalPlanner
+from .exec.stats import QueryStats
 from .planner.logical import LogicalPlanner
 from .planner.optimizer import optimize
 from .planner.plan import PlanNode, plan_text
-from .spi.batch import ColumnBatch
+from .spi.batch import Column, ColumnBatch
+from .spi.types import VARCHAR
+from .sql import ast
 from .sql.parser import parse_statement
 
 __all__ = ["QueryResult", "StandaloneQueryRunner"]
+
+
+def text_result(name: str, lines: list[str]) -> "QueryResult":
+    return QueryResult([name], ColumnBatch(
+        [name], [Column.from_values(VARCHAR, lines)]))
 
 
 @dataclass
@@ -50,7 +58,9 @@ class StandaloneQueryRunner:
         self.session = session if session is not None else Session()
 
     def create_plan(self, sql: str) -> PlanNode:
-        stmt = parse_statement(sql)
+        return self._plan_stmt(parse_statement(sql))
+
+    def _plan_stmt(self, stmt: ast.Statement) -> PlanNode:
         planner = LogicalPlanner(self.catalog, self.session.default_catalog)
         plan = planner.plan(stmt)
         return optimize(plan, self.catalog)
@@ -59,22 +69,56 @@ class StandaloneQueryRunner:
         return plan_text(self.create_plan(sql))
 
     def execute(self, sql: str) -> QueryResult:
-        plan = self.create_plan(sql)
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain):
+            return self._execute_explain(stmt)
+        if isinstance(stmt, ast.ShowTables):
+            conn = self.catalog.connector(self.session.default_catalog)
+            return text_result("Table", conn.list_tables())
+        if isinstance(stmt, ast.ShowColumns):
+            cat, table, schema = self.catalog.resolve_table(
+                stmt.table, self.session.default_catalog)
+            return text_result(
+                "Column", [f"{c.name} {c.type}" for c in schema.columns])
+        result, _ = self._execute_stmt(stmt, collect_stats=False)
+        return result
+
+    def _execute_stmt(self, stmt: ast.Statement, collect_stats: bool,
+                      plan: Optional[PlanNode] = None,
+                      ) -> tuple[QueryResult, Optional[QueryStats]]:
+        if plan is None:
+            plan = self._plan_stmt(stmt)
         local = LocalPlanner(
             self.catalog,
             splits_per_node=self.session.splits_per_node,
             node_count=self.session.node_count,
         ).plan(plan)
-        run_pipelines(local.pipelines)
+        stats = QueryStats() if collect_stats else None
+        run_pipelines(local.pipelines, stats)
         batches = local.collector.batches
         if batches:
             batch = ColumnBatch.concat(batches)
         else:
-            from .spi.batch import Column
-
             batch = ColumnBatch(
                 local.output_names,
                 [Column(t, np.empty(0, t.storage_dtype))
                  for t in local.output_types],
             )
-        return QueryResult(local.output_names, batch)
+        return QueryResult(local.output_names, batch), stats
+
+    def _execute_explain(self, stmt: ast.Explain) -> QueryResult:
+        """EXPLAIN -> plan text; EXPLAIN ANALYZE -> run it, then render the
+        plan with per-operator wall/row/batch stats (the
+        ExplainAnalyzeOperator.java:36 equivalent)."""
+        inner = stmt.statement
+        plan = self._plan_stmt(inner)
+        lines = plan_text(plan).splitlines()
+        if stmt.analyze:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            _, stats = self._execute_stmt(inner, collect_stats=True, plan=plan)
+            wall = _time.perf_counter() - t0
+            lines.append(f"total: {wall * 1e3:.1f} ms")
+            lines.extend(stats.text().splitlines())
+        return text_result("Query Plan", lines)

@@ -1,0 +1,116 @@
+"""Dynamic filtering: build-side key domains prune probe scans without
+changing results (reference: server/DynamicFilterService.java:105,
+operator/DynamicFilterSourceOperator.java:44)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.exec.dynamic_filter import DynamicFilterHolder
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session, StandaloneQueryRunner
+from trino_tpu.testing.oracle import SqliteOracle, assert_same_rows
+
+TABLES = ["nation", "region", "part", "lineitem", "orders", "customer"]
+
+
+@pytest.fixture(scope="module")
+def harness():
+    catalog = default_catalog(scale_factor=0.01)
+    runner = StandaloneQueryRunner(catalog)
+    oracle = SqliteOracle()
+    conn = catalog.connector("tpch")
+    for t in TABLES:
+        schema = conn.get_table_schema(t)
+        cols = schema.column_names()
+        batches = []
+        for s in conn.get_splits(t, 2, 1):
+            src = conn.create_page_source(s, cols)
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is not None:
+                    batches.append(b)
+        oracle.load_table(t, batches)
+    return runner, oracle
+
+
+def test_holder_numeric_set_and_range():
+    h = DynamicFilterHolder()
+    h.fill(np.array([5, 7, 7, 9]), None, None)
+    mask = h.probe_mask(np.array([4, 5, 6, 7, 9, 10]), None, None)
+    assert list(mask) == [False, True, False, True, True, False]
+
+
+def test_holder_null_probe_keys_dropped():
+    h = DynamicFilterHolder()
+    h.fill(np.array([1, 2]), None, None)
+    mask = h.probe_mask(np.array([1, 2]), np.array([True, False]), None)
+    assert list(mask) == [True, False]
+
+
+def test_holder_empty_build():
+    h = DynamicFilterHolder()
+    h.fill(np.array([], dtype=np.int64), None, None)
+    assert h.empty
+    assert not h.probe_mask(np.array([1, 2, 3]), None, None).any()
+
+
+def test_holder_dictionary_values():
+    h = DynamicFilterHolder()
+    d = np.array(["AFRICA", "ASIA"], dtype=object)
+    h.fill(np.array([0, 1, 1]), None, d)
+    probe_dict = np.array(["AMERICA", "ASIA", "EUROPE"], dtype=object)
+    mask = h.probe_mask(np.array([0, 1, 2]), None, probe_dict)
+    assert list(mask) == [False, True, False]
+
+
+SELECTIVE_JOINS = [
+    # selective build (one region) prunes the nation probe
+    "select n_name from nation, region "
+    "where n_regionkey = r_regionkey and r_name = 'ASIA'",
+    # Q17-flavored: small part subset prunes lineitem
+    "select sum(l_extendedprice) from lineitem, part "
+    "where l_partkey = p_partkey and p_brand = 'Brand#23' "
+    "and p_container = 'MED BOX'",
+    # chained joins: both filters apply
+    "select count(*) from lineitem, orders, customer "
+    "where l_orderkey = o_orderkey and o_custkey = c_custkey "
+    "and c_mktsegment = 'BUILDING' and o_orderdate < date '1993-01-01'",
+]
+
+
+@pytest.mark.parametrize("sql", SELECTIVE_JOINS)
+def test_results_unchanged(harness, sql):
+    runner, oracle = harness
+    expected = oracle.query(sql)
+    assert_same_rows(runner.execute(sql).rows(), expected)
+    # and identical with dynamic filtering off
+    off = StandaloneQueryRunner(
+        runner.catalog, session=Session(dynamic_filtering=False))
+    assert_same_rows(off.execute(sql).rows(), expected)
+
+
+def test_probe_rows_actually_pruned(harness):
+    """EXPLAIN ANALYZE shows the probe scan emitting far fewer rows than the
+    table when the build side is selective."""
+    runner, oracle = harness
+    sql = ("explain analyze select sum(l_extendedprice) from lineitem, part "
+           "where l_partkey = p_partkey and p_brand = 'Brand#23' "
+           "and p_container = 'MED BOX'")
+    out = "\n".join(r[0] for r in runner.execute(sql).rows())
+    # lineitem at SF0.01 has ~60k rows; a 1-of-brands x 1-of-containers
+    # part filter keeps well under a tenth of them
+    import re
+
+    scans = [int(m) for m in re.findall(
+        r"ScanOperator.*?out (\d+) rows", out)]
+    assert scans, out
+    assert min(scans) < 6000, out
+
+
+def test_distributed_results_unchanged(harness):
+    _, oracle = harness
+    catalog = default_catalog(scale_factor=0.01)
+    dist = DistributedQueryRunner(catalog, worker_count=3)
+    for sql in SELECTIVE_JOINS:
+        assert_same_rows(dist.execute(sql).rows(), oracle.query(sql))

@@ -1,0 +1,78 @@
+"""Bounded-memory operator behavior: PARTIAL pre-aggregation flush and
+streaming TopN (reference: InMemoryHashAggregationBuilder partial flush,
+operator/TopNOperator.java)."""
+
+import numpy as np
+
+from trino_tpu.exec.operators import HashAggregationOperator, TopNOperator
+from trino_tpu.planner.plan import AggCall, SortKey
+from trino_tpu.spi.batch import Column, ColumnBatch
+from trino_tpu.spi.types import BIGINT
+
+
+def _batch(keys, vals):
+    return ColumnBatch(
+        ["k", "v"],
+        [Column(BIGINT, np.asarray(keys, np.int64)),
+         Column(BIGINT, np.asarray(vals, np.int64))])
+
+
+def test_partial_agg_flushes_early():
+    op = HashAggregationOperator(
+        [0], [AggCall("sum", 1, BIGINT)], ["k", "s"], [BIGINT, BIGINT],
+        step="PARTIAL")
+    op.FLUSH_ROWS = 100  # tiny window for the test
+    for i in range(10):
+        op.add_input(_batch(np.arange(50) % 7, np.ones(50)))
+    # several flushes must already be available before finish
+    flushed = []
+    while True:
+        b = op.get_output()
+        if b is None:
+            break
+        flushed.append(b)
+    assert flushed, "expected pre-finish partial flushes"
+    op.finish_input()
+    while True:
+        b = op.get_output()
+        if b is None and op.is_finished():
+            break
+        if b is not None:
+            flushed.append(b)
+    # merged totals must equal a single-shot aggregation
+    totals = {}
+    for b in flushed:
+        for k, s in b.to_pylist():
+            totals[k] = totals.get(k, 0) + s
+    expected = {k: sum(1 for i in range(50) if i % 7 == k) * 10
+                for k in range(7)}
+    assert totals == expected
+
+
+def test_partial_agg_buffer_bounded():
+    op = HashAggregationOperator(
+        [0], [AggCall("sum", 1, BIGINT)], ["k", "s"], [BIGINT, BIGINT],
+        step="PARTIAL")
+    op.FLUSH_ROWS = 128
+    for i in range(100):
+        op.add_input(_batch(np.arange(64) % 5, np.ones(64)))
+        assert op._buffered_rows <= 128 + 64
+        while op.get_output() is not None:
+            pass
+
+
+def test_topn_state_bounded():
+    op = TopNOperator(10, [SortKey(1, ascending=False)])
+    op._shrink_at = 200
+    rng = np.random.default_rng(0)
+    seen = []
+    for i in range(50):
+        vals = rng.integers(0, 1_000_000, 100)
+        seen.append(vals)
+        op.add_input(_batch(np.arange(100), vals))
+        assert op._buffered_rows <= 300  # never more than shrink_at + batch
+    op.finish_input()
+    out = op.get_output()
+    got = sorted((r[1] for r in out.to_pylist()), reverse=True)
+    expected = sorted(np.concatenate(seen).tolist(), reverse=True)[:10]
+    assert got == expected

@@ -22,7 +22,11 @@ from ..connectors.catalog import Catalog
 from ..planner import plan as P
 from ..spi.batch import Column, ColumnBatch
 from ..spi.types import Type
+from ..sql.ir import InputRef
+from .dynamic_filter import DynamicFilterHolder
+from .revoking import TaskMemoryContext
 from .operators import (
+    BufferedInputMixin,
     DistinctLimitOperator,
     FilterProjectOperator,
     HashAggregationOperator,
@@ -60,7 +64,9 @@ class LocalExecutionPlan:
 class LocalPlanner:
     def __init__(self, catalog: Catalog, splits_per_node: int = 4,
                  node_count: int = 1, task_index: int = 0,
-                 task_count: int = 1, remote_clients=None):
+                 task_count: int = 1, remote_clients=None,
+                 dynamic_filtering: bool = True,
+                 hbm_limit_bytes: int = 16 << 30):
         self.catalog = catalog
         self.splits_per_node = splits_per_node
         self.node_count = node_count
@@ -69,6 +75,10 @@ class LocalPlanner:
         self.task_index = task_index
         self.task_count = task_count
         self.remote_clients = remote_clients or {}
+        self.dynamic_filtering = dynamic_filtering
+        # per-task HBM pool: blocking operators reserve buffered device
+        # bytes as revocable memory (exec/revoking.py)
+        self.memory = TaskMemoryContext(hbm_limit_bytes)
         self.pipelines: list[list[Operator]] = []
 
     def plan(self, root: P.PlanNode) -> LocalExecutionPlan:
@@ -76,6 +86,10 @@ class LocalPlanner:
         collector = OutputCollector()
         chain.append(collector)
         self.pipelines.append(chain)
+        for p in self.pipelines:
+            for op in p:
+                if isinstance(op, BufferedInputMixin):
+                    op.attach_memory(self.memory)
         return LocalExecutionPlan(
             self.pipelines, collector, root.output_names, root.output_types)
 
@@ -90,9 +104,15 @@ class LocalPlanner:
             return [ScanOperator(conn, mine, node.columns)]
 
         if isinstance(node, P.RemoteSource):
+            from ..execution.collective_exchange import (
+                CollectiveRepartitionExchange,
+                CollectiveSourceOperator,
+            )
             from ..execution.task import RemoteExchangeSourceOperator
 
             client = self.remote_clients[node.fragment_id]
+            if isinstance(client, CollectiveRepartitionExchange):
+                return [CollectiveSourceOperator(client, self.task_index)]
             return [RemoteExchangeSourceOperator(client)]
 
         if isinstance(node, P.Filter):
@@ -116,12 +136,27 @@ class LocalPlanner:
 
         if isinstance(node, P.Join):
             bridge = JoinBridge()
+            # dynamic filtering: INNER/RIGHT probe rows that cannot match are
+            # droppable, so the build-side key domain prunes the probe scan
+            # (exec/dynamic_filter.py; server/DynamicFilterService.java:105)
+            holders = [None] * len(node.right_keys)
+            scan_attach = []
+            if (self.dynamic_filtering and node.left_keys
+                    and node.join_type in ("INNER", "RIGHT")):
+                for k, lch in enumerate(node.left_keys):
+                    col = _trace_to_scan_col(node.left, lch)
+                    if col is not None:
+                        holders[k] = DynamicFilterHolder()
+                        scan_attach.append((col, holders[k]))
             build = self._chain(node.right)
             build.append(JoinBuildSink(
                 bridge, node.right_keys,
-                node.right.output_types, node.right.output_names))
+                node.right.output_types, node.right.output_names,
+                dynamic_filter_holders=holders))
             self.pipelines.append(build)
             chain = self._chain(node.left)
+            if scan_attach and isinstance(chain[0], ScanOperator):
+                chain[0].dynamic_filters.extend(scan_attach)
             chain.append(LookupJoinOperator(
                 bridge, node.left_keys, node.join_type, node.residual,
                 node.output_names, node.output_types))
@@ -206,6 +241,37 @@ class LocalPlanner:
             return chain
 
         raise NotImplementedError(f"no operator for {type(node).__name__}")
+
+
+def _trace_to_scan_col(node: P.PlanNode, ch: int) -> Optional[int]:
+    """Map an output channel down the probe-side left spine to a TableScan
+    column index, or None if the channel is computed / crosses a remote or
+    union boundary.  Descends only paths whose rows pass through unchanged
+    (a dropped probe row cannot change other rows' results)."""
+    while True:
+        if isinstance(node, P.TableScan):
+            return ch
+        if isinstance(node, (P.Filter, P.Exchange)):
+            node = node.source
+            continue
+        if isinstance(node, P.Project):
+            e = node.expressions[ch]
+            if isinstance(e, InputRef):
+                node, ch = node.source, e.index
+                continue
+            return None
+        if isinstance(node, P.Join):
+            lw = len(node.left.output_types)
+            if ch < lw:
+                node = node.left
+                continue
+            return None
+        if isinstance(node, P.SemiJoin):
+            if ch < len(node.source.output_types):
+                node = node.source
+                continue
+            return None
+        return None
 
 
 def _values_batch(node: P.Values) -> ColumnBatch:

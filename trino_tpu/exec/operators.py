@@ -94,18 +94,35 @@ class Operator:
 
 class ScanOperator(Operator):
     """Reads splits via the connector page source (operator/
-    TableScanOperator.java:46)."""
+    TableScanOperator.java:46).  ``dynamic_filters`` [(column_idx, holder)]
+    prune rows before padding/device transfer (the probe side of
+    DynamicFilterService — see exec/dynamic_filter.py)."""
 
     def __init__(self, connector: Connector, splits: Sequence[Split],
-                 columns: Sequence[str]):
+                 columns: Sequence[str], dynamic_filters=None):
         self.connector = connector
         self.splits = list(splits)
         self.columns = list(columns)
+        self.dynamic_filters = list(dynamic_filters or [])
         self._source = None
         self.input_done = True
 
     def needs_input(self) -> bool:
         return False
+
+    def _apply_dynamic_filters(self, batch: ColumnBatch) -> ColumnBatch:
+        mask = None
+        for col_idx, holder in self.dynamic_filters:
+            c = batch.columns[col_idx]
+            m = holder.probe_mask(c.data, c.valid, c.dictionary)
+            if m is not None:
+                mask = m if mask is None else (mask & m)
+        if mask is None or mask.all():
+            return batch
+        for _, holder in self.dynamic_filters:
+            holder.rows_pruned += int(batch.num_rows - mask.sum())
+            break  # credit once per batch
+        return batch.filter(mask)
 
     def get_output(self) -> Optional[ColumnBatch]:
         while True:
@@ -122,6 +139,10 @@ class ScanOperator(Operator):
                 continue
             batch = self._source.get_next_batch()
             if batch is not None:
+                if self.dynamic_filters:
+                    batch = self._apply_dynamic_filters(batch)
+                    if batch.num_rows == 0:
+                        continue
                 # bucket scan output shapes so every downstream jitted
                 # program compiles once per (pipeline, bucket)
                 return pad_to_bucket(batch)
@@ -354,6 +375,51 @@ class RenameOperator(Operator):
 
 
 # ---------------------------------------------------------------------------
+# memory-accounted input buffering (the revocable-memory participants)
+
+
+class BufferedInputMixin:
+    """Blocking operators accumulate ``self._batches``; with a
+    TaskMemoryContext attached (exec/revoking.py) the buffered DEVICE bytes
+    are reserved as revocable HBM and evicted to host RAM on revoke."""
+
+    _mem = None  # TaskMemoryContext, set via attach_memory
+
+    def attach_memory(self, mem) -> None:
+        self._mem = mem
+        if mem is not None:
+            mem.register(self)
+
+    def account_memory(self) -> None:
+        if self._mem is not None:
+            from .revoking import batch_device_residual
+
+            self._mem.update(self, batch_device_residual(self))
+
+    def revoke_memory(self) -> int:
+        from .revoking import batch_device_nbytes
+
+        freed = 0
+        batches = getattr(self, "_batches", [])
+        for i, b in enumerate(batches):
+            d = batch_device_nbytes(b)
+            if d:
+                batches[i] = b.to_host()
+                freed += d
+        if freed:
+            self.spill_count = getattr(self, "spill_count", 0) + 1
+        return freed
+
+    def release_memory(self) -> None:
+        """Drop the input buffer + its reservation after finish consumes it
+        (a lingering reservation would trigger pointless spills of dead
+        buffers in later operators sharing the pool)."""
+        self._batches = []
+        if self._mem is not None:
+            self._mem.update(self, 0)
+
+
+# ---------------------------------------------------------------------------
 # aggregation
 
 
@@ -405,10 +471,19 @@ def _concat_device(batches: Sequence[ColumnBatch]) -> ColumnBatch:
     return ColumnBatch(names, out_cols, live)
 
 
-class HashAggregationOperator(Operator):
+class HashAggregationOperator(BufferedInputMixin, Operator):
     """Grouped aggregation: accumulate batches, then sort-based segment
     reduction (replaces operator/HashAggregationOperator.java:53 +
-    FlatHash.java:42 with the kernels in exec/kernels.py)."""
+    FlatHash.java:42 with the kernels in exec/kernels.py).
+
+    PARTIAL steps flush early: when the buffered input exceeds
+    ``flush_rows``, the accumulated batches are pre-aggregated and emitted
+    immediately (states are mergeable by FINAL), so a worker's memory stays
+    bounded by the flush window rather than its whole input — the
+    InMemoryHashAggregationBuilder partial-flush behavior
+    (operator/aggregation/builder/InMemoryHashAggregationBuilder.java)."""
+
+    FLUSH_ROWS = 1 << 20
 
     def __init__(self, group_keys: Sequence[int], aggs: Sequence[AggCall],
                  output_names: Sequence[str], output_types: Sequence[Type],
@@ -419,12 +494,27 @@ class HashAggregationOperator(Operator):
         self.output_types = list(output_types)
         self.step = step
         self._batches: list[ColumnBatch] = []
+        self._buffered_rows = 0
+        self._flushed: list[ColumnBatch] = []
         self._result: Optional[ColumnBatch] = None
         self._emitted = False
+
+    def _can_flush(self) -> bool:
+        # PARTIAL states merge downstream; SINGLE/FINAL must see all input.
+        # (distinct never reaches PARTIAL — AddExchanges routes it SINGLE.)
+        return self.step == "PARTIAL" and bool(self.group_keys)
 
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_rows:
             self._batches.append(batch)
+            self._buffered_rows += batch.num_rows
+            if self._can_flush() and self._buffered_rows >= self.FLUSH_ROWS:
+                out = self._compute()
+                if out.num_rows:
+                    self._flushed.append(out)
+                self._batches = []
+                self._buffered_rows = 0
+            self.account_memory()
 
     def _agg_spec(self, a: AggCall, inp: ColumnBatch, out_t: Type):
         """kernel (fn, data, valid, dtype, distinct) for one AggCall."""
@@ -447,7 +537,13 @@ class HashAggregationOperator(Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
+        if self._flushed and not self._batches:
+            self._result = None  # everything already emitted via flushes
+            self._emitted = True
+            self.release_memory()
+            return
         self._result = self._compute()
+        self.release_memory()
 
     def _empty_result(self, nk: int) -> ColumnBatch:
         if nk:  # grouped agg over empty input -> empty result
@@ -619,13 +715,16 @@ class HashAggregationOperator(Operator):
         return ColumnBatch(self.output_names, out_cols)
 
     def get_output(self) -> Optional[ColumnBatch]:
-        if self._result is not None and not self._emitted:
+        if self._flushed:
+            return self._flushed.pop(0)
+        if self.input_done and self._result is not None and not self._emitted:
             self._emitted = True
             return self._result
         return None
 
     def is_finished(self) -> bool:
-        return (self.input_done and self._emitted) or self._closed
+        return (self.input_done and self._emitted
+                and not self._flushed) or self._closed
 
 
 # ---------------------------------------------------------------------------
@@ -668,21 +767,26 @@ def _probe_key_tuple(col: Column, build_dict: Optional[np.ndarray]):
     return data, valid
 
 
-class JoinBuildSink(Operator):
+class JoinBuildSink(BufferedInputMixin, Operator):
     """Accumulates the build side, then builds the sorted-hash join table
     (operator/join/HashBuilderOperator.java:57)."""
 
     def __init__(self, bridge: JoinBridge, key_channels: Sequence[int],
-                 types: Sequence[Type], names: Sequence[str]):
+                 types: Sequence[Type], names: Sequence[str],
+                 dynamic_filter_holders=None):
         self.bridge = bridge
         self.key_channels = list(key_channels)
         self.types = list(types)
         self.names = list(names)
+        # one holder per key channel (or None) — filled at finish so the
+        # probe-side scan can prune (exec/dynamic_filter.py)
+        self.dynamic_filter_holders = list(dynamic_filter_holders or [])
         self._batches: list[ColumnBatch] = []
 
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_rows:
             self._batches.append(batch)
+            self.account_memory()
 
     def finish_input(self) -> None:
         super().finish_input()
@@ -696,10 +800,16 @@ class JoinBuildSink(Operator):
             c = batch.columns[ch]
             keys.append((np.asarray(c.data),
                          None if c.valid is None else np.asarray(c.valid)))
+        for k, holder in zip(range(len(self.key_channels)),
+                             self.dynamic_filter_holders):
+            if holder is not None:
+                c = batch.columns[self.key_channels[k]]
+                holder.fill(keys[k][0], keys[k][1], c.dictionary)
         self.bridge.batch = batch
         self.bridge.key_dicts = [
             batch.columns[ch].dictionary for ch in self.key_channels]
         self.bridge.table = K.build_join_table(keys, num_rows=batch.num_rows)
+        self.release_memory()
 
     def is_finished(self) -> bool:
         return self.input_done
@@ -707,10 +817,49 @@ class JoinBuildSink(Operator):
 
 def _null_columns(batch: ColumnBatch, n: int) -> list[Column]:
     return [
-        Column(c.type, np.zeros(n, np.asarray(c.data).dtype),
+        Column(c.type, np.zeros(n, c.data.dtype),
                np.zeros(n, bool), c.dictionary)
         for c in batch.columns
     ]
+
+
+# residual predicates over join candidate pairs: jitted once per
+# (expression, types, dictionaries) and evaluated on bucket-padded pair
+# batches so repeated probes reuse a handful of compiled programs (the same
+# cross-execution caching strategy as FilterProjectOperator._PROGRAM_CACHE)
+_RESIDUAL_CACHE: dict = {}
+_RESIDUAL_LOCK = threading.Lock()
+
+
+def _residual_program(expr: RowExpression, types, dicts):
+    key = (expr, tuple(types),
+           tuple(id(d) if d is not None else None for d in dicts))
+    with _RESIDUAL_LOCK:
+        hit = _RESIDUAL_CACHE.get(key)
+        if hit is not None:
+            return hit[0]
+    ce = compile_expression(expr, list(types), list(dicts))
+
+    def run(cols):
+        data, valid = ce(cols)
+        return data if valid is None else (data & valid)
+
+    prog = jax.jit(run)
+    with _RESIDUAL_LOCK:
+        _RESIDUAL_CACHE.setdefault(key, (prog, list(dicts)))
+        if len(_RESIDUAL_CACHE) > 1024:
+            _RESIDUAL_CACHE.pop(next(iter(_RESIDUAL_CACHE)))
+    return prog
+
+
+def _pad_indices(idx: np.ndarray) -> tuple[np.ndarray, int]:
+    """Pad an index vector to its power-of-two bucket (clamped repeats of
+    slot 0 keep gathers in-range; callers mask the tail with ``live``)."""
+    n = len(idx)
+    cap = K.bucket(n)
+    if cap == n:
+        return idx, n
+    return np.concatenate([idx, np.zeros(cap - n, idx.dtype)]), n
 
 
 class LookupJoinOperator(Operator):
@@ -730,15 +879,16 @@ class LookupJoinOperator(Operator):
         self.residual = residual
         self.output_names = list(output_names)
         self.output_types = list(output_types)
-        self._pending: Optional[ColumnBatch] = None
-        self._residual_fn = None
+        from collections import deque
+
+        self._pending: "deque[ColumnBatch]" = deque()
         self._build_matched: Optional[np.ndarray] = None
         self._emitted_unmatched = False
         # probe-side dictionaries observed, for null-extended unmatched rows
         self._probe_dicts: Optional[list] = None
 
     def needs_input(self) -> bool:
-        return self.bridge.ready and self._pending is None and super().needs_input()
+        return self.bridge.ready and not self._pending and super().needs_input()
 
     def add_input(self, probe: ColumnBatch) -> None:
         build = self.bridge.batch
@@ -760,15 +910,16 @@ class LookupJoinOperator(Operator):
                 raise RuntimeError("scalar subquery returned multiple rows")
 
         if self.residual is not None and len(pi):
-            pair = self._pair_batch(probe, build, pi, bi)
-            if self._residual_fn is None:
-                self._residual_fn = compile_expression(
-                    self.residual, [c.type for c in pair.columns],
-                    [c.dictionary for c in pair.columns])
-            data, valid = self._residual_fn(_to_cols(pair))
-            mask = np.asarray(data)
-            if valid is not None:
-                mask = mask & np.asarray(valid)
+            # pad candidates to their bucket so the jitted residual program
+            # (and every downstream shape) recompiles per bucket, not per
+            # distinct match count
+            pidx, n = _pad_indices(pi)
+            bidx, _ = _pad_indices(bi)
+            pair = self._pair_batch(probe, build, pidx, bidx)
+            prog = _residual_program(
+                self.residual, [c.type for c in pair.columns],
+                [c.dictionary for c in pair.columns])
+            mask = np.asarray(jax.device_get(prog(_to_cols(pair))))[:n]
             pi, bi = pi[mask], bi[mask]
 
         if self.join_type in ("RIGHT", "FULL"):
@@ -785,23 +936,22 @@ class LookupJoinOperator(Operator):
                      else np.asarray(probe.live))
             un = np.nonzero(alive & ~matched)[0]
             if len(un):
-                left_cols = [c.take(un) for c in probe.columns]
-                right_cols = _null_columns(build, len(un))
-                extra = left_cols + right_cols
-                pi_all = self._pair_batch(probe, build, pi, bi)
-                combined = ColumnBatch(
-                    self.output_names,
-                    [
-                        Column(t, np.concatenate([np.asarray(a.data), np.asarray(b.data)]),
-                               _concat_valid(a, b), a.dictionary if a.dictionary is not None else b.dictionary)
-                        for a, b, t in zip(pi_all.columns, extra, self.output_types)
-                    ],
-                )
-                self._pending = combined
-                return
-        out = self._pair_batch(probe, build, pi, bi).rename(self.output_names)
-        if out.num_rows:
-            self._pending = out
+                # null-extended unmatched probe rows go out as their own
+                # bucket-padded batch (no host-side concat with the pairs)
+                uidx, un_n = _pad_indices(un)
+                left_cols = [c.take(uidx) for c in probe.columns]
+                right_cols = _null_columns(build, len(uidx))
+                live = (None if len(uidx) == un_n
+                        else np.arange(len(uidx)) < un_n)
+                self._pending.append(ColumnBatch(
+                    self.output_names, left_cols + right_cols, live))
+        if len(pi):
+            pidx, n = _pad_indices(pi)
+            bidx, _ = _pad_indices(bi)
+            out = self._pair_batch(probe, build, pidx, bidx)
+            live = None if len(pidx) == n else np.arange(len(pidx)) < n
+            self._pending.append(ColumnBatch(
+                self.output_names, out.columns, live))
 
     def _pair_batch(self, probe: ColumnBatch, build: ColumnBatch,
                     pi: np.ndarray, bi: np.ndarray) -> ColumnBatch:
@@ -832,9 +982,8 @@ class LookupJoinOperator(Operator):
         return ColumnBatch(self.output_names, left_cols + right_cols)
 
     def get_output(self) -> Optional[ColumnBatch]:
-        if self._pending is not None:
-            b, self._pending = self._pending, None
-            return b
+        if self._pending:
+            return self._pending.popleft()
         if (self.input_done and not self._closed
                 and self.join_type in ("RIGHT", "FULL")
                 and not self._emitted_unmatched):
@@ -845,16 +994,10 @@ class LookupJoinOperator(Operator):
     def is_finished(self) -> bool:
         if self._closed:
             return True
-        done = self.input_done and self._pending is None
+        done = self.input_done and not self._pending
         if self.join_type in ("RIGHT", "FULL"):
             return done and self._emitted_unmatched
         return done
-
-
-def _concat_valid(a: Column, b: Column) -> Optional[np.ndarray]:
-    if a.valid is None and b.valid is None:
-        return None
-    return np.concatenate([a.valid_mask(), b.valid_mask()])
 
 
 class SemiJoinOperator(Operator):
@@ -874,7 +1017,6 @@ class SemiJoinOperator(Operator):
         self.output_names = list(output_names)
         self.output_types = list(output_types)
         self._pending: Optional[ColumnBatch] = None
-        self._residual_fn = None
 
     def needs_input(self) -> bool:
         return self.bridge.ready and self._pending is None and super().needs_input()
@@ -899,18 +1041,16 @@ class SemiJoinOperator(Operator):
         else:
             pi, bi = K.probe_join_table(self.bridge.table, keys, batch.live)
         if self.residual is not None and len(pi):
-            pair_cols = [c.take(pi) for c in batch.columns] + [
-                c.take(bi) for c in self.bridge.batch.columns]
+            pidx, n = _pad_indices(pi)
+            bidx, _ = _pad_indices(bi)
+            pair_cols = [c.take(pidx) for c in batch.columns] + [
+                c.take(bidx) for c in self.bridge.batch.columns]
             pair = ColumnBatch(
                 [f"c{i}" for i in range(len(pair_cols))], pair_cols)
-            if self._residual_fn is None:
-                self._residual_fn = compile_expression(
-                    self.residual, [c.type for c in pair.columns],
-                    [c.dictionary for c in pair.columns])
-            data, valid = self._residual_fn(_to_cols(pair))
-            mask = np.asarray(data)
-            if valid is not None:
-                mask = mask & np.asarray(valid)
+            prog = _residual_program(
+                self.residual, [c.type for c in pair.columns],
+                [c.dictionary for c in pair.columns])
+            mask = np.asarray(jax.device_get(prog(_to_cols(pair))))[:n]
             pi = pi[mask]
         matched = np.zeros(batch.num_rows, bool)
         matched[pi] = True
@@ -936,7 +1076,7 @@ class SemiJoinOperator(Operator):
 # window
 
 
-class WindowOperator(Operator):
+class WindowOperator(BufferedInputMixin, Operator):
     """Window-function evaluation (operator/WindowOperator.java:69): blocking
     — accumulate, then one jitted program per (spec, shape bucket) computes
     every function and scatters results back to input order (see
@@ -958,6 +1098,7 @@ class WindowOperator(Operator):
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_rows:
             self._batches.append(batch)
+            self.account_memory()
 
     def finish_input(self) -> None:
         super().finish_input()
@@ -996,6 +1137,7 @@ class WindowOperator(Operator):
                 valid = None  # never NULL
             out_cols.append(Column(f.type, data, valid, dict_))
         self._result = ColumnBatch(self.output_names, out_cols)
+        self.release_memory()
 
     def get_output(self) -> Optional[ColumnBatch]:
         if self._result is not None and not self._emitted:
@@ -1021,7 +1163,7 @@ def _sort_key_tuples(batch: ColumnBatch, keys: Sequence[SortKey]):
     return out
 
 
-class SortOperator(Operator):
+class SortOperator(BufferedInputMixin, Operator):
     def __init__(self, keys: Sequence[SortKey]):
         self.keys = list(keys)
         self._batches: list[ColumnBatch] = []
@@ -1031,6 +1173,7 @@ class SortOperator(Operator):
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_rows:
             self._batches.append(batch)
+            self.account_memory()
 
     def finish_input(self) -> None:
         super().finish_input()
@@ -1040,6 +1183,7 @@ class SortOperator(Operator):
         inp = ColumnBatch.concat(self._batches)
         perm = K.sort_perm(_sort_key_tuples(inp, self.keys))
         self._result = inp.take(perm)
+        self.release_memory()
 
     def get_output(self):
         if self._result is not None and not self._emitted:
@@ -1052,12 +1196,31 @@ class SortOperator(Operator):
 
 
 class TopNOperator(SortOperator):
-    """Full-sort-then-slice for now; streaming partial top-n per batch is the
-    obvious next optimization (operator/TopNOperator.java:34)."""
+    """Streaming top-N (operator/TopNOperator.java:34): when the buffer
+    outgrows a multiple of N, it is compacted to the current best N rows, so
+    state stays O(N + batch) instead of O(input)."""
 
     def __init__(self, count: int, keys: Sequence[SortKey]):
         super().__init__(keys)
         self.count = count
+        self._buffered_rows = 0
+        self._shrink_at = max(4 * count, 1 << 16)
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        if not batch.num_rows:
+            return
+        self._batches.append(batch)
+        self._buffered_rows += batch.num_rows
+        if self._buffered_rows > self._shrink_at:
+            self._shrink()
+        self.account_memory()
+
+    def _shrink(self) -> None:
+        inp = ColumnBatch.concat(self._batches)
+        perm = K.sort_perm(_sort_key_tuples(inp, self.keys))
+        best = inp.take(np.asarray(perm)[: self.count])
+        self._batches = [best]
+        self._buffered_rows = best.num_rows
 
     def finish_input(self) -> None:
         super().finish_input()
@@ -1089,7 +1252,7 @@ class LimitOperator(Operator):
         return (self.input_done or self._remaining == 0) and self._pending is None
 
 
-class DistinctLimitOperator(Operator):
+class DistinctLimitOperator(BufferedInputMixin, Operator):
     """DISTINCT (optionally limited): dedup via the grouping kernel."""
 
     def __init__(self, count: Optional[int]):
@@ -1101,6 +1264,7 @@ class DistinctLimitOperator(Operator):
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_rows:
             self._batches.append(batch)
+            self.account_memory()
 
     def finish_input(self) -> None:
         super().finish_input()
@@ -1119,6 +1283,7 @@ class DistinctLimitOperator(Operator):
         if self.count is not None:
             out = out.slice(0, self.count)
         self._result = out
+        self.release_memory()
 
     def get_output(self):
         if self._result is not None and not self._emitted:

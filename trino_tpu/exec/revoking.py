@@ -1,0 +1,108 @@
+"""Task memory context + revocation: the HBM pool's spill trigger.
+
+Wires ``spi/memory.py`` (MemoryPool/LocalMemoryContext — the
+lib/trino-memory-context port) into the operators: every blocking operator
+reserves its buffered DEVICE bytes as revocable memory; when a reservation
+would exceed the HBM pool, the context asks the largest holders to revoke —
+they evict their buffered batches to host RAM (``ColumnBatch.to_host``),
+dropping the device references so XLA can free the buffers.  This is the
+first spill tier of the reference's
+``execution/MemoryRevokingScheduler.java:47`` +
+``operator/aggregation/builder/SpillableHashAggregationBuilder.java`` design:
+HBM -> host RAM (disk is a later tier).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+import numpy as np
+
+from ..spi.memory import AggregatedMemoryContext, ExceededMemoryLimitError, MemoryPool
+
+__all__ = ["TaskMemoryContext", "device_nbytes", "batch_device_nbytes"]
+
+
+def device_nbytes(arr) -> int:
+    """Bytes an array holds on device (0 for host numpy)."""
+    if arr is None or isinstance(arr, np.ndarray):
+        return 0
+    return int(np.dtype(arr.dtype).itemsize * arr.size)
+
+
+def batch_device_nbytes(batch) -> int:
+    n = 0
+    for c in batch.columns:
+        n += device_nbytes(c.data) + device_nbytes(c.valid)
+    n += device_nbytes(batch.live)
+    return n
+
+
+class Revocable(Protocol):
+    def revoke_memory(self) -> int:
+        """Evict buffered device state to host; return bytes freed."""
+
+
+class TaskMemoryContext:
+    """Per-task accounting root: one HBM pool shared by the task's operators.
+
+    ``update(op, nbytes)`` adjusts op's revocable reservation; on overflow it
+    revokes from the largest other holders first (mirrors
+    MemoryRevokingScheduler's TASK_THRESHOLD ordering), then from ``op``
+    itself, and only then raises ExceededMemoryLimitError.
+    """
+
+    def __init__(self, hbm_limit_bytes: int):
+        self.pool = MemoryPool("hbm", hbm_limit_bytes)
+        self.root = AggregatedMemoryContext(pool=self.pool, revocable=True)
+        self._locals: dict[int, object] = {}
+        self._ops: dict[int, Revocable] = {}
+
+    def register(self, op) -> None:
+        key = id(op)
+        if key not in self._locals:
+            self._locals[key] = self.root.new_local(type(op).__name__)
+            self._ops[key] = op
+
+    def update(self, op, nbytes: int) -> None:
+        """Set op's revocable reservation to ``nbytes``, revoking other
+        holders (largest first) and finally op itself when the pool is full.
+
+        Revocable reservations never throw in MemoryPool.reserve (matching
+        the reference), so capacity is checked here and spills are triggered
+        synchronously — the single-threaded stand-in for
+        MemoryRevokingScheduler's listener."""
+        key = id(op)
+        self.register(op)
+        ctx = self._locals[key]
+        delta = nbytes - ctx.reserved
+        if delta > 0 and self.pool.free_bytes < delta:
+            holders = sorted(
+                ((k, c) for k, c in self._locals.items()
+                 if c.reserved > 0 and k != key),
+                key=lambda kv: kv[1].reserved, reverse=True)
+            for k, c in holders:
+                freed = self._ops[k].revoke_memory()
+                if freed:
+                    c.set_bytes(max(0, c.reserved - freed))
+                if self.pool.free_bytes >= delta:
+                    break
+            if self.pool.free_bytes < delta:
+                # last resort: the requester evicts its own buffer
+                self._ops[key].revoke_memory()
+                nbytes = batch_device_residual(self._ops[key])
+                delta = nbytes - ctx.reserved
+                if delta > 0 and self.pool.free_bytes < delta:
+                    raise ExceededMemoryLimitError(
+                        self.pool.name, delta, self.pool.max_bytes)
+        ctx.set_bytes(nbytes)
+
+    def reserved_bytes(self) -> int:
+        return self.pool.reserved + self.pool.reserved_revocable
+
+
+def batch_device_residual(op) -> int:
+    batches = getattr(op, "_batches", None)
+    if not batches:
+        return 0
+    return sum(batch_device_nbytes(b) for b in batches)

@@ -94,6 +94,11 @@ class DistributedQueryRunner:
 
     def _execute_subplan(self, subplan: SubPlan,
                          stats_sink: Optional[list]) -> QueryResult:
+        from .collective_exchange import (
+            CollectiveRepartitionExchange,
+            collectives_available,
+        )
+
         fragments = subplan.all_fragments()
 
         stages: dict[int, _Stage] = {}
@@ -112,6 +117,23 @@ class DistributedQueryRunner:
             nparts = consumer_tasks.get(f.id, 1)
             stages[f.id].buffers = [OutputBuffer(nparts) for _ in range(tc)]
 
+        # device-collective REPARTITION edges (all_to_all over the mesh)
+        # where producer/consumer task counts line up; host buffers remain
+        # the fallback for every other edge
+        collective_edges: dict[int, CollectiveRepartitionExchange] = {}
+        if self.session.use_collectives:
+            for f in fragments:
+                tc = stages[f.id].task_count
+                if (f.output_kind == "REPARTITION"
+                        and consumer_tasks.get(f.id) == tc
+                        and collectives_available(tc)):
+                    collective_edges[f.id] = CollectiveRepartitionExchange(
+                        tc, f.output_keys,
+                        f.root.output_names, f.root.output_types)
+        # kept as an attribute for observability/tests; tasks receive the
+        # dict as an argument so concurrent queries cannot cross-wire
+        self._collective_edges = collective_edges
+
         errors: list[BaseException] = []
         threads: list[threading.Thread] = []
         for f in fragments:
@@ -119,7 +141,8 @@ class DistributedQueryRunner:
             for t in range(stage.task_count):
                 th = threading.Thread(
                     target=self._run_task,
-                    args=(stage, t, stages, errors, stats_sink),
+                    args=(stage, t, stages, errors, stats_sink,
+                          collective_edges),
                     name=f"task-{f.id}.{t}",
                     daemon=True,
                 )
@@ -133,6 +156,8 @@ class DistributedQueryRunner:
             for s in stages.values():
                 for b in s.buffers:
                     b.abort()
+            for ex in collective_edges.values():
+                ex.abort()
             if errors:
                 raise errors[0]
             raise TimeoutError(f"tasks did not complete: {hung}")
@@ -158,11 +183,14 @@ class DistributedQueryRunner:
 
     def _run_task(self, stage: _Stage, task_index: int,
                   stages: dict[int, "_Stage"], errors: list,
-                  stats_sink: Optional[list] = None) -> None:
+                  stats_sink: Optional[list] = None,
+                  collective: Optional[dict] = None) -> None:
         try:
             f = stage.fragment
+            collective = collective or {}
             clients = {
-                src: ExchangeClient(stages[src].buffers, task_index)
+                src: (collective[src] if src in collective
+                      else ExchangeClient(stages[src].buffers, task_index))
                 for src in f.source_fragments
             }
             planner = LocalPlanner(
@@ -172,13 +200,20 @@ class DistributedQueryRunner:
                 task_index=task_index,
                 task_count=stage.task_count,
                 remote_clients=clients,
+                dynamic_filtering=self.session.dynamic_filtering,
+                hbm_limit_bytes=self.session.hbm_limit_bytes,
             )
             local = planner.plan(f.root)
             # swap the collector for the task's output sink
-            sink = PartitionedOutputSink(
-                stage.buffers[task_index],
-                f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
-                f.output_keys)
+            if f.id in collective:
+                from .collective_exchange import CollectiveOutputSink
+
+                sink = CollectiveOutputSink(collective[f.id], task_index)
+            else:
+                sink = PartitionedOutputSink(
+                    stage.buffers[task_index],
+                    f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
+                    f.output_keys)
             local.pipelines[-1][-1] = sink
             stats = None
             if stats_sink is not None:

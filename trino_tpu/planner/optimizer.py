@@ -291,10 +291,32 @@ def _flatten(node: PlanNode, catalog: Catalog):
     return leaves, conjuncts, total
 
 
+def _hoist_common_or(e: RowExpression) -> list[RowExpression]:
+    """(A ∧ X) ∨ (A ∧ Y) → [A, X ∨ Y] — extract conjuncts common to every
+    OR arm (reference: sql/planner/iterative/rule/... ExtractCommonPredicates
+    ExpressionRewriter; Kleene 3VL is distributive, so this is exact).  The
+    unlocked equality conjuncts turn Q19-style OR-of-ANDs cross joins into
+    hash joins."""
+    if not (isinstance(e, Call) and e.name == "$or"):
+        return [e]
+    arms = [_split_and(a) for a in e.args]
+    common = [t for t in arms[0]
+              if all(any(t == u for u in arm) for arm in arms[1:])]
+    if not common:
+        return [e]
+    reduced = [[t for t in arm if t not in common] for arm in arms]
+    out = list(common)
+    if all(reduced):  # an empty remainder makes the OR vacuous given common
+        out.append(Call(BOOLEAN, "$or",
+                        tuple(_conjoin(r) for r in reduced)))
+    return out
+
+
 def _rewrite_filter_cluster(node: PlanNode, catalog: Catalog):
     if isinstance(node, Filter):
         cluster_root = node.source
-        preds = _split_and(node.predicate)
+        preds = [p for c in _split_and(node.predicate)
+                 for p in _hoist_common_or(c)]
     else:
         cluster_root = node
         preds = []

@@ -49,6 +49,12 @@ class Session:
     default_catalog: str = "tpch"
     splits_per_node: int = 4
     node_count: int = 1
+    dynamic_filtering: bool = True
+    # per-task HBM pool limit for blocking operators' buffered device bytes
+    hbm_limit_bytes: int = 16 << 30
+    # REPARTITION edges run as device collectives (all_to_all) when the
+    # mesh has enough devices; host exchange is the fallback
+    use_collectives: bool = True
 
 
 class StandaloneQueryRunner:
@@ -92,6 +98,8 @@ class StandaloneQueryRunner:
             self.catalog,
             splits_per_node=self.session.splits_per_node,
             node_count=self.session.node_count,
+            dynamic_filtering=self.session.dynamic_filtering,
+            hbm_limit_bytes=self.session.hbm_limit_bytes,
         ).plan(plan)
         stats = QueryStats() if collect_stats else None
         run_pipelines(local.pipelines, stats)

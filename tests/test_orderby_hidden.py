@@ -1,0 +1,53 @@
+"""ORDER BY expressions outside the select list (hidden sort channels,
+pruned after the sort — Trino QueryPlanner orderingScheme)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.testing.oracle import SqliteOracle, assert_same_rows
+
+
+@pytest.fixture(scope="module")
+def harness():
+    catalog = default_catalog(scale_factor=0.01)
+    runner = StandaloneQueryRunner(catalog)
+    dist = DistributedQueryRunner(catalog, worker_count=3)
+    oracle = SqliteOracle()
+    conn = catalog.connector("tpch")
+    for t in ("nation", "orders"):
+        schema = conn.get_table_schema(t)
+        cols = schema.column_names()
+        batches = []
+        for s in conn.get_splits(t, 2, 1):
+            src = conn.create_page_source(s, cols)
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is not None:
+                    batches.append(b)
+        oracle.load_table(t, batches)
+    return runner, dist, oracle
+
+
+QUERIES = [
+    "select n_name from nation order by n_regionkey, n_name limit 7",
+    "select n_name from nation order by n_regionkey * 2 + n_nationkey desc limit 5",
+    "select o_orderdate from orders order by o_orderkey limit 3",
+    # mix of projected and hidden keys
+    "select n_regionkey, n_name from nation order by n_comment limit 4",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_hidden_order_by(harness, sql):
+    runner, dist, oracle = harness
+    expected = oracle.query(sql)
+    assert_same_rows(runner.execute(sql).rows(), expected, ordered=True)
+    assert_same_rows(dist.execute(sql).rows(), expected, ordered=True)
+
+
+def test_distinct_rejects_hidden_keys(harness):
+    runner, _, _ = harness
+    with pytest.raises(Exception, match="DISTINCT"):
+        runner.execute("select distinct n_name from nation order by n_regionkey")

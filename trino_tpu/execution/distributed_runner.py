@@ -163,13 +163,15 @@ class DistributedQueryRunner:
             raise TimeoutError(f"tasks did not complete: {hung}")
 
         # drain the root stage's buffer as the client
+        from .task import maybe_deserialize
+
         root = stages[subplan.fragment.id]
         client = ExchangeClient(root.buffers, 0)
         batches = []
         while not client.is_finished():
             b = client.poll(timeout=0.2)
             if b is not None:
-                batches.append(b)
+                batches.append(maybe_deserialize(b))
         names = list(subplan.fragment.root.output_names)
         types = list(subplan.fragment.root.output_types)
         if batches:
@@ -213,7 +215,7 @@ class DistributedQueryRunner:
                 sink = PartitionedOutputSink(
                     stage.buffers[task_index],
                     f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
-                    f.output_keys)
+                    f.output_keys, serde=self.session.exchange_serde)
             local.pipelines[-1][-1] = sink
             stats = None
             if stats_sink is not None:

@@ -18,8 +18,30 @@ from ..exec import kernels as K
 from ..exec.operators import Operator
 from ..spi.batch import Column, ColumnBatch
 from .exchange import ExchangeClient, OutputBuffer
+from .serde import deserialize_batch, serialize_batch
 
-__all__ = ["RemoteExchangeSourceOperator", "PartitionedOutputSink"]
+__all__ = ["RemoteExchangeSourceOperator", "PartitionedOutputSink",
+           "SerializedPage", "maybe_deserialize"]
+
+
+class SerializedPage:
+    """A batch serialized to wire bytes (execution/serde.py) — what a real
+    network transport would carry (buffer/PageSerializer.java:58)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.data)
+
+
+def maybe_deserialize(page):
+    if isinstance(page, SerializedPage):
+        return deserialize_batch(page.data)
+    return page
 
 
 def _dict_value_hashes(dictionary: np.ndarray) -> np.ndarray:
@@ -58,7 +80,7 @@ class RemoteExchangeSourceOperator(Operator):
         while not self.client.is_finished():
             page = self.client.poll(timeout=0.2)
             if page is not None:
-                return page
+                return maybe_deserialize(page)
             if time.monotonic() > deadline:
                 raise TimeoutError("exchange source stalled >300s")
         return None
@@ -72,10 +94,16 @@ class PartitionedOutputSink(Operator):
     output keys, BROADCAST replicates, GATHER/OUTPUT lands in partition 0."""
 
     def __init__(self, buffer: OutputBuffer, kind: str,
-                 keys: Sequence[int] = ()):
+                 keys: Sequence[int] = (), serde: bool = False):
         self.buffer = buffer
         self.kind = kind
         self.keys = list(keys)
+        self.serde = serde  # serialize pages to wire bytes (network mode)
+
+    def _page(self, batch: ColumnBatch):
+        if self.serde:
+            return SerializedPage(serialize_batch(batch))
+        return batch
 
     def add_input(self, batch: ColumnBatch) -> None:
         # the exchange is a host/network boundary: densify device batches
@@ -90,12 +118,13 @@ class PartitionedOutputSink(Operator):
             for p in range(n):
                 sub = batch.filter(parts == p)
                 if sub.num_rows:
-                    self.buffer.enqueue(p, sub)
+                    self.buffer.enqueue(p, self._page(sub))
         elif self.kind == "BROADCAST" and n > 1:
+            page = self._page(batch)
             for p in range(n):
-                self.buffer.enqueue(p, batch)
+                self.buffer.enqueue(p, page)
         else:
-            self.buffer.enqueue(0, batch)
+            self.buffer.enqueue(0, self._page(batch))
 
     def finish_input(self) -> None:
         super().finish_input()

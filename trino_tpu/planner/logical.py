@@ -163,15 +163,50 @@ class LogicalPlanner:
                 ctes[w.name] = w.query
         rel, select_irs = self.plan_body(q.body, outer, ctes)
 
-        # ORDER BY / LIMIT over the projected relation
+        # ORDER BY / LIMIT over the projected relation.  Keys not in the
+        # select list become hidden channels appended to the projection and
+        # pruned after the sort (Trino: QueryPlanner orderingScheme over
+        # hidden symbols; SELECT DISTINCT forbids them per spec).
         if q.order_by:
             keys = []
+            hidden: list[RowExpression] = []
             for item in q.order_by:
-                ch = self._order_channel(item.expr, q.body, rel, select_irs, outer, ctes)
+                try:
+                    ch = self._order_channel(
+                        item.expr, q.body, rel, select_irs, outer, ctes)
+                except AnalysisError:
+                    tr = self._select_context_translator(q.body, outer, ctes)
+                    if tr is None:
+                        raise
+                    if isinstance(rel.node, Aggregate):
+                        raise AnalysisError(
+                            "for SELECT DISTINCT, ORDER BY expressions must "
+                            f"appear in select list: {item.expr}")
+                    hidden.append(tr(item.expr))
+                    ch = -len(hidden)  # placeholder, resolved below
                 nf = item.nulls_first
                 if nf is None:
                     nf = not item.ascending  # SQL default: NULLS LAST asc
                 keys.append(SortKey(ch, item.ascending, nf))
+            base_width = rel.width
+            if hidden:
+                proj = rel.node
+                if not isinstance(proj, Project):
+                    raise AnalysisError(
+                        f"ORDER BY expression not in select list: {q.order_by}")
+                ext = Project(
+                    tuple(proj.output_names) + tuple(
+                        f"_ord{i}" for i in range(len(hidden))),
+                    tuple(proj.output_types) + tuple(e.type for e in hidden),
+                    proj.source,
+                    tuple(proj.expressions) + tuple(hidden))
+                rel = RelationPlan(ext, rel.qualifiers + [None] * len(hidden))
+                keys = [
+                    k if k.channel >= 0 else
+                    SortKey(base_width + (-k.channel - 1), k.ascending,
+                            k.nulls_first)
+                    for k in keys
+                ]
             if q.limit is not None:
                 node = TopN(rel.node.output_names, rel.node.output_types,
                             rel.node, q.limit, tuple(keys))
@@ -179,6 +214,14 @@ class LogicalPlanner:
                 node = Sort(rel.node.output_names, rel.node.output_types,
                             rel.node, tuple(keys))
             rel = RelationPlan(node, rel.qualifiers)
+            if hidden:  # prune the hidden sort channels
+                prune = Project(
+                    tuple(node.output_names[:base_width]),
+                    tuple(node.output_types[:base_width]),
+                    node,
+                    tuple(InputRef(node.output_types[i], i)
+                          for i in range(base_width)))
+                rel = RelationPlan(prune, rel.qualifiers[:base_width])
         elif q.limit is not None:
             rel = RelationPlan(
                 Limit(rel.node.output_names, rel.node.output_types, rel.node, q.limit),
@@ -351,6 +394,7 @@ class LogicalPlanner:
                  for c in split_conjuncts(spec.having)])
 
         has_aggs = bool(collector.calls)
+        covered_check = None
         if has_group or has_aggs:
             # GROUP BY <ordinal> resolves to the select item's expression
             # (SqlBase.g4 groupBy -> expression; ordinal handling mirrors
@@ -378,6 +422,7 @@ class LogicalPlanner:
                     return all(covered(a) for a in e.args)
                 return False
 
+            covered_check = covered
             for it, e in zip(select_items, select_irs):
                 if not covered(e):
                     raise AnalysisError(
@@ -432,11 +477,23 @@ class LogicalPlanner:
                             tuple(range(len(names))), ())
             out = RelationPlan(agg, [None] * len(names))
 
-        # stash context for ORDER BY expression matching
+        # stash context for ORDER BY expression matching.  ORDER BY hidden
+        # channels run through the same coverage validation as select items:
+        # an uncovered pre-aggregation reference must error, never silently
+        # index a post-aggregation channel.
+        planned_agg_count = len(collector.calls)
+
         def translate_in_select_ctx(e: ast.Expr) -> RowExpression:
             t = Translator(scope, aggregates=collector, windows=wcollector)
             ir = t.translate(e)
+            if len(collector.calls) != planned_agg_count:
+                raise AnalysisError(
+                    f"ORDER BY aggregate not in select list: {e}")
             if has_group or has_aggs:
+                if covered_check is not None and not covered_check(ir):
+                    raise AnalysisError(
+                        f"'{e}' must be an aggregate expression or appear "
+                        "in GROUP BY clause")
                 ir = rewrite_expr(ir, rewrite)
             if win_rewrite:
                 ir = rewrite_expr(ir, win_rewrite)

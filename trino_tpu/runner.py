@@ -55,6 +55,8 @@ class Session:
     # REPARTITION edges run as device collectives (all_to_all) when the
     # mesh has enough devices; host exchange is the fallback
     use_collectives: bool = True
+    # serialize exchange pages to compressed wire bytes (network mode)
+    exchange_serde: bool = False
 
 
 class StandaloneQueryRunner:

@@ -1,0 +1,4 @@
+"""HTTP server + client protocol layer (L8/L9)."""
+
+from .protocol import QueryDispatcher, TrinoTpuServer  # noqa: F401
+from .client import Client  # noqa: F401

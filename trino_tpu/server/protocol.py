@@ -1,0 +1,209 @@
+"""REST statement protocol: POST /v1/statement + nextUri paging.
+
+The stdlib-only analogue of the reference's client protocol
+(core/trino-main/.../dispatcher/QueuedStatementResource.java:104 +
+protocol/ExecutingStatementResource + docs/src/main/sphinx/develop/
+client-protocol.md): a client POSTs SQL, receives a query id and a
+``nextUri``, and follows nextUri until ``state`` is FINISHED, collecting
+``columns`` + ``data`` pages along the way.  DELETE cancels.
+
+The dispatcher runs queries on a bounded worker pool (the miniature of
+dispatcher/DispatchManager + resource-group admission) against either
+runner; results are paged back JSON-encoded.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+__all__ = ["QueryDispatcher", "TrinoTpuServer"]
+
+_PAGE_ROWS = 4096
+
+
+def _json_value(v):
+    import datetime
+    import decimal
+
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    if isinstance(v, float) and v != v:  # NaN
+        return "NaN"
+    return v
+
+
+class _Query:
+    def __init__(self, qid: str, sql: str):
+        self.id = qid
+        self.sql = sql
+        self.state = "QUEUED"
+        self.error: Optional[str] = None
+        self.columns: Optional[list] = None
+        self.rows: list = []
+        self.done = threading.Event()
+        self.cancelled = False
+
+
+class QueryDispatcher:
+    """Admission + execution: a bounded pool of query slots (the stand-in
+    for DispatchManager + resource groups)."""
+
+    def __init__(self, runner, max_concurrent: int = 4):
+        self.runner = runner
+        self.pool = ThreadPoolExecutor(max_workers=max_concurrent)
+        self.queries: dict[str, _Query] = {}
+        self._lock = threading.Lock()
+
+    MAX_RETAINED = 256
+
+    def submit(self, sql: str) -> _Query:
+        q = _Query(uuid.uuid4().hex[:16], sql)
+        with self._lock:
+            self.queries[q.id] = q
+            # bound the registry: evict oldest finished queries (the
+            # reference expires results once the client stops polling)
+            finished = [k for k, v in self.queries.items() if v.done.is_set()]
+            for k in finished[:max(0, len(self.queries) - self.MAX_RETAINED)]:
+                del self.queries[k]
+        self.pool.submit(self._run, q)
+        return q
+
+    def _run(self, q: _Query) -> None:
+        if q.cancelled:
+            q.state = "CANCELED"
+            q.done.set()
+            return
+        q.state = "RUNNING"
+        try:
+            result = self.runner.execute(q.sql)
+            if q.cancelled:
+                # the engine ran to completion (no mid-kernel interruption
+                # yet), but a cancelled query must not deliver results
+                q.state = "CANCELED"
+                q.done.set()
+                return
+            q.columns = [
+                {"name": n, "type": str(t)}
+                for n, t in zip(result.names, result.batch.types)
+            ]
+            q.rows = [[_json_value(v) for v in row] for row in result.rows()]
+            q.state = "FINISHED"
+        except Exception as e:  # surfaced through the protocol, not the log
+            q.error = f"{type(e).__name__}: {e}"
+            q.state = "FAILED"
+        q.done.set()
+
+    def get(self, qid: str) -> Optional[_Query]:
+        with self._lock:
+            return self.queries.get(qid)
+
+    def cancel(self, qid: str) -> bool:
+        q = self.get(qid)
+        if q is None:
+            return False
+        q.cancelled = True
+        return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    dispatcher: QueryDispatcher = None  # set by TrinoTpuServer
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _query_payload(self, q: _Query, token: int) -> dict:
+        out = {
+            "id": q.id,
+            "stats": {"state": q.state},
+        }
+        if q.state in ("QUEUED", "RUNNING"):
+            out["nextUri"] = f"/v1/statement/{q.id}/{token}"
+            return out
+        if q.state == "FAILED":
+            out["error"] = {"message": q.error}
+            return out
+        # FINISHED: page the rows out
+        if q.columns is not None:
+            out["columns"] = q.columns
+        start = token * _PAGE_ROWS
+        page = q.rows[start:start + _PAGE_ROWS]
+        if page:
+            out["data"] = page
+        if start + _PAGE_ROWS < len(q.rows):
+            out["nextUri"] = f"/v1/statement/{q.id}/{token + 1}"
+        return out
+
+    def do_POST(self):
+        if self.path.rstrip("/") != "/v1/statement":
+            self._send(404, {"error": {"message": "not found"}})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        sql = self.rfile.read(length).decode("utf-8")
+        q = self.dispatcher.submit(sql)
+        self._send(200, self._query_payload(q, 0))
+
+    def do_GET(self):
+        parts = self.path.strip("/").split("/")
+        # /v1/statement/{id}/{token}
+        if len(parts) != 4 or parts[:2] != ["v1", "statement"]:
+            self._send(404, {"error": {"message": "not found"}})
+            return
+        q = self.dispatcher.get(parts[2])
+        if q is None:
+            self._send(404, {"error": {"message": "unknown query"}})
+            return
+        # brief server-side wait cuts client poll round trips
+        q.done.wait(timeout=0.5)
+        self._send(200, self._query_payload(q, int(parts[3])))
+
+    def do_DELETE(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 3 and parts[:2] == ["v1", "statement"]:
+            ok = self.dispatcher.cancel(parts[2])
+            self._send(200 if ok else 404, {"cancelled": ok})
+            return
+        self._send(404, {"error": {"message": "not found"}})
+
+
+class TrinoTpuServer:
+    """In-process HTTP server hosting the statement protocol."""
+
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrent: int = 4):
+        self.dispatcher = QueryDispatcher(runner, max_concurrent)
+        handler = type("_BoundHandler", (_Handler,),
+                       {"dispatcher": self.dispatcher})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def start(self) -> "TrinoTpuServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="trino-tpu-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

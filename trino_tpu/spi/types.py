@@ -172,6 +172,7 @@ def parse_type(text: str) -> Type:
         "varchar": VARCHAR,
         "char": VARCHAR,
         "string": VARCHAR,
+        "unknown": UNKNOWN,
     }
     if t in simple:
         return simple[t]

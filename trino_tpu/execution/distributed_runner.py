@@ -99,19 +99,17 @@ class DistributedQueryRunner:
             collectives_available,
         )
 
+        if self.session.retry_policy == "TASK":
+            from .fte import run_fte_query
+
+            return self._to_result(subplan, run_fte_query(self, subplan,
+                                                          stats_sink))
+
         fragments = subplan.all_fragments()
-
-        stages: dict[int, _Stage] = {}
-        for f in fragments:
-            tc = 1 if f.partitioning == "SINGLE" else self.worker_count
-            stages[f.id] = _Stage(f, tc, [])
-
-        # output buffer partition count = consumer task count (the root's
-        # consumer is the client: 1)
-        consumer_tasks: dict[int, int] = {}
-        for f in fragments:
-            for src in f.source_fragments:
-                consumer_tasks[src] = stages[f.id].task_count
+        task_counts, consumer_tasks = self.stage_task_counts(fragments)
+        stages: dict[int, _Stage] = {
+            f.id: _Stage(f, task_counts[f.id], []) for f in fragments
+        }
         for f in fragments:
             tc = stages[f.id].task_count
             nparts = consumer_tasks.get(f.id, 1)
@@ -172,16 +170,31 @@ class DistributedQueryRunner:
             b = client.poll(timeout=0.2)
             if b is not None:
                 batches.append(maybe_deserialize(b))
+        return self._to_result(subplan, batches)
+
+    def stage_task_counts(self, fragments) -> tuple[dict, dict]:
+        """(fragment -> task count, fragment -> consumer task count); the
+        output-buffer partition count of a fragment is its consumer's task
+        count (the root's consumer is the client: 1)."""
+        task_counts = {
+            f.id: (1 if f.partitioning == "SINGLE" else self.worker_count)
+            for f in fragments
+        }
+        consumer_tasks: dict[int, int] = {}
+        for f in fragments:
+            for src in f.source_fragments:
+                consumer_tasks[src] = task_counts[f.id]
+        return task_counts, consumer_tasks
+
+    def _to_result(self, subplan: SubPlan, batches: list) -> QueryResult:
         names = list(subplan.fragment.root.output_names)
         types = list(subplan.fragment.root.output_types)
         if batches:
-            batch = ColumnBatch.concat(batches)
-        else:
-            import numpy as np
+            return QueryResult(names, ColumnBatch.concat(batches))
+        import numpy as np
 
-            batch = ColumnBatch(names, [
-                Column(t, np.empty(0, t.storage_dtype)) for t in types])
-        return QueryResult(names, batch)
+        return QueryResult(names, ColumnBatch(names, [
+            Column(t, np.empty(0, t.storage_dtype)) for t in types]))
 
     def _run_task(self, stage: _Stage, task_index: int,
                   stages: dict[int, "_Stage"], errors: list,

@@ -57,6 +57,10 @@ class Session:
     use_collectives: bool = True
     # serialize exchange pages to compressed wire bytes (network mode)
     exchange_serde: bool = False
+    # NONE = streaming pipelined scheduler; TASK = fault-tolerant execution
+    # (stage-by-stage spooled exchange + per-task retry)
+    retry_policy: str = "NONE"
+    task_retry_attempts: int = 2
 
 
 class StandaloneQueryRunner:

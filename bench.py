@@ -94,6 +94,7 @@ def _stage_memory_tables(sf: float):
                     batches.append(b)
         mem.create_table(TableSchema(t, schema.columns))
         mem.finish_insert(t, [[ColumnBatch.concat(batches)]])
+        mem.pin_to_device(t)  # hot tables live in device memory
     return catalog
 
 

@@ -149,7 +149,40 @@ def test_union_column_count_mismatch(harness):
         runner.execute("select 1, 2 union select 3")
 
 
-def test_intersect_all_rejected(harness):
-    runner, _, _ = harness
-    with pytest.raises(Exception, match="not yet supported"):
-        runner.execute("select 1 intersect all select 1")
+def _multiset_counts(rows):
+    from collections import Counter
+
+    return Counter(tuple(r) for r in rows)
+
+
+def test_intersect_all(harness):
+    """INTERSECT ALL keeps min(left, right) multiplicities (sqlite lacks the
+    ALL variants, so the expectation is computed from the two inputs)."""
+    runner, dist, oracle = harness
+    left = "select n_regionkey from nation"  # 5 copies of each region key
+    right = ("select r_regionkey from region union all "
+             "select r_regionkey from region where r_regionkey < 2")
+    sql = f"{left} intersect all ({right})"
+    lc = _multiset_counts(oracle.query(left))
+    rc = _multiset_counts(oracle.query(
+        "select r_regionkey from region union all "
+        "select r_regionkey from region where r_regionkey < 2"))
+    expected = []
+    for k in lc.keys() & rc.keys():
+        expected.extend([k] * min(lc[k], rc[k]))
+    assert_same_rows(runner.execute(sql).rows(), expected)
+    assert_same_rows(dist.execute(sql).rows(), expected)
+
+
+def test_except_all(harness):
+    runner, dist, oracle = harness
+    left = "select n_regionkey from nation"
+    right = "select r_regionkey from region where r_regionkey < 3"
+    sql = f"{left} except all {right}"
+    lc = _multiset_counts(oracle.query(left))
+    rc = _multiset_counts(oracle.query(right))
+    expected = []
+    for k, n in lc.items():
+        expected.extend([k] * max(n - rc.get(k, 0), 0))
+    assert_same_rows(runner.execute(sql).rows(), expected)
+    assert_same_rows(dist.execute(sql).rows(), expected)

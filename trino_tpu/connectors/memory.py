@@ -64,6 +64,9 @@ class MemoryConnector(Connector):
         self._lock = threading.Lock()
         self._schemas: dict[str, TableSchema] = {}
         self._data: dict[str, list[ColumnBatch]] = {}
+        # live-row counts of device-pinned tables (padding rows excluded;
+        # computed once at pin time to avoid per-query device syncs)
+        self._pinned_rows: dict[str, int] = {}
 
     def list_tables(self) -> list[str]:
         with self._lock:
@@ -77,7 +80,10 @@ class MemoryConnector(Connector):
 
     def get_table_statistics(self, table: str) -> TableStatistics:
         with self._lock:
-            rows = sum(b.num_rows for b in self._data.get(table, []))
+            if table in self._pinned_rows:
+                rows = self._pinned_rows[table]
+            else:
+                rows = sum(b.num_rows for b in self._data.get(table, []))
         return TableStatistics(row_count=float(rows))
 
     def create_table(self, schema: TableSchema) -> None:
@@ -118,6 +124,59 @@ class MemoryConnector(Connector):
         with self._lock:
             for staged in fragments:
                 self._data[table].extend(staged)
+
+    def pin_to_device(self, table: str) -> None:
+        """Make a table device-resident: batches become bucket-padded jax
+        arrays living in HBM, so scans hand columns straight to the jitted
+        pipeline with no host->device upload per query.  The TPU-native
+        equivalent of the reference keeping hot pages in worker heap
+        (MemoryPagesStore) — here the 'heap' is device memory."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+
+        from ..spi.batch import Column, ColumnBatch, round_up_pow2
+
+        with self._lock:
+            batches = self._data.get(table, [])
+            total_rows = 0
+            pinned = []
+            for b in batches:
+                b = b.compact()
+                total_rows += b.num_rows
+                if b.live is None:
+                    # a live mask marks the batch device-pinned downstream
+                    # (ScanOperator skips host work for it) — attach an
+                    # all-ones mask even when no padding was needed
+                    b = ColumnBatch(b.names, b.columns,
+                                    _np.ones(b.num_rows, _np.bool_))
+                n = len(b.columns[0]) if b.columns else 0
+                cap = round_up_pow2(n)
+                pad = cap - n
+                cols = []
+                for c in b.columns:
+                    data = _np.asarray(c.data)
+                    if pad:
+                        data = _np.concatenate(
+                            [data, _np.zeros(pad, data.dtype)])
+                    valid = None
+                    if c.valid is not None:
+                        valid = _np.asarray(c.valid)
+                        if pad:
+                            valid = _np.concatenate(
+                                [valid, _np.zeros(pad, _np.bool_)])
+                    cols.append(Column(
+                        c.type, jax.device_put(jnp.asarray(data)),
+                        None if valid is None
+                        else jax.device_put(jnp.asarray(valid)),
+                        c.dictionary))
+                live = _np.asarray(b.live)
+                if pad:
+                    live = _np.concatenate([live, _np.zeros(pad, _np.bool_)])
+                pinned.append(ColumnBatch(
+                    b.names, cols, jax.device_put(jnp.asarray(live))))
+            self._data[table] = pinned
+            self._pinned_rows[table] = total_rows
 
 
 class _NullSink(ConnectorPageSink):

@@ -43,10 +43,29 @@ class Driver:
         dst.input_batches += 1
 
     def run(self) -> None:
+        """Run to completion (single-driver execution)."""
+        while True:
+            status = self.process()
+            if status == "finished":
+                return
+            if status == "blocked":
+                stuck = [type(o).__name__ for o in self.operators
+                         if not o.is_finished()]
+                raise RuntimeError(f"driver stalled; unfinished: {stuck}")
+
+    def process(self, deadline: float = float("inf")) -> str:
+        """One scheduling quantum: move pages until ``deadline`` (a
+        time.perf_counter() timestamp), the driver finishes, or no operator
+        can make progress.  Returns 'finished' | 'progressed' | 'blocked'
+        (blocked = alive but waiting on an external input, e.g. an exchange
+        or a bridge).  This is the yieldable unit the time-sharing executor
+        schedules (reference: operator/Driver.processFor +
+        TimeSharingTaskExecutor quanta)."""
         ops = self.operators
         n = len(ops)
         timed = self.stats is not None
         st = self.stats.operators if timed else None
+        any_progress = False
         while not ops[-1].is_finished():
             progressed = False
             for i in range(n - 1):
@@ -77,12 +96,15 @@ class Driver:
             if ops[-1].is_finished():
                 break
             if not progressed:
-                stuck = [type(o).__name__ for o in ops if not o.is_finished()]
-                raise RuntimeError(f"driver stalled; unfinished: {stuck}")
+                return "progressed" if any_progress else "blocked"
+            any_progress = True
+            if time.perf_counter() >= deadline:
+                return "progressed"
         # upstream of an early-finished sink gets closed so sources release
         for op in ops[:-1]:
             if not op.is_finished():
                 op.close()
+        return "finished"
 
 
 def run_pipelines(pipelines: Sequence[Sequence[Operator]],

@@ -38,6 +38,7 @@ from .operators import (
     Operator,
     OutputCollector,
     RenameOperator,
+    ReplicateOperator,
     ScanOperator,
     SemiJoinOperator,
     SortOperator,
@@ -203,6 +204,11 @@ class LocalPlanner:
         if isinstance(node, P.Limit):
             chain = self._chain(node.source)
             chain.append(LimitOperator(node.count))
+            return chain
+
+        if isinstance(node, P.Replicate):
+            chain = self._chain(node.source)
+            chain.append(ReplicateOperator(node.count_channel))
             return chain
 
         if isinstance(node, P.DistinctLimit):

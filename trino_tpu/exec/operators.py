@@ -54,6 +54,7 @@ __all__ = [
     "TopNOperator",
     "WindowOperator",
     "LimitOperator",
+    "ReplicateOperator",
     "DistinctLimitOperator",
     "TableWriterOperator",
     "OutputCollector",
@@ -139,7 +140,10 @@ class ScanOperator(Operator):
                 continue
             batch = self._source.get_next_batch()
             if batch is not None:
-                if self.dynamic_filters:
+                # device-pinned batches (live mask set) skip host-side
+                # dynamic filtering — pulling them down would cost more
+                # than the pruning saves
+                if self.dynamic_filters and batch.live is None:
                     batch = self._apply_dynamic_filters(batch)
                     if batch.num_rows == 0:
                         continue
@@ -1226,6 +1230,33 @@ class TopNOperator(SortOperator):
         super().finish_input()
         if self._result is not None:
             self._result = self._result.slice(0, self.count)
+
+
+class ReplicateOperator(Operator):
+    """Emit each row N times, N from a count channel (the row-expansion leg
+    of INTERSECT/EXCEPT ALL — see planner Replicate node)."""
+
+    def __init__(self, count_channel: int):
+        self.count_channel = count_channel
+        self._pending: Optional[ColumnBatch] = None
+
+    def needs_input(self) -> bool:
+        return self._pending is None and super().needs_input()
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        batch = batch.compact()
+        counts = np.asarray(batch.columns[self.count_channel].data)
+        counts = np.clip(counts, 0, None)
+        idx = np.repeat(np.arange(batch.num_rows), counts)
+        if len(idx):
+            self._pending = batch.take(idx)
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        b, self._pending = self._pending, None
+        return b
+
+    def is_finished(self) -> bool:
+        return self.input_done and self._pending is None
 
 
 class LimitOperator(Operator):

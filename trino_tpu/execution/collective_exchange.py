@@ -298,6 +298,8 @@ class CollectiveOutputSink(Operator):
 class CollectiveSourceOperator(Operator):
     """Consumer-side source: emits this task's device shard once."""
 
+    blocking = True  # see RemoteExchangeSourceOperator
+
     def __init__(self, exchange: CollectiveRepartitionExchange, task_index: int):
         self.exchange = exchange
         self.task_index = task_index
@@ -310,6 +312,8 @@ class CollectiveSourceOperator(Operator):
     def get_output(self) -> Optional[ColumnBatch]:
         if self._emitted or self._closed:
             return None
+        if not self.blocking and not self.exchange._done.is_set():
+            return None  # park; the executor reschedules us
         self._emitted = True
         batch = self.exchange.take(self.task_index)
         return batch if batch.num_rows else None

@@ -110,10 +110,18 @@ class DistributedQueryRunner:
         stages: dict[int, _Stage] = {
             f.id: _Stage(f, task_counts[f.id], []) for f in fragments
         }
+        # TIME_SHARING: enqueue backpressure would pin a bounded worker
+        # inside its quantum (sinks have no non-blocking mode yet), so
+        # buffers are uncapped there — the spool-everything trade
+        unbounded = self.session.task_scheduler == "TIME_SHARING"
         for f in fragments:
             tc = stages[f.id].task_count
             nparts = consumer_tasks.get(f.id, 1)
-            stages[f.id].buffers = [OutputBuffer(nparts) for _ in range(tc)]
+            stages[f.id].buffers = [
+                OutputBuffer(nparts,
+                             max_bytes=(1 << 62) if unbounded else 256 << 20)
+                for _ in range(tc)
+            ]
 
         # device-collective REPARTITION edges (all_to_all over the mesh)
         # where producer/consumer task counts line up; host buffers remain
@@ -133,23 +141,27 @@ class DistributedQueryRunner:
         self._collective_edges = collective_edges
 
         errors: list[BaseException] = []
-        threads: list[threading.Thread] = []
-        for f in fragments:
-            stage = stages[f.id]
-            for t in range(stage.task_count):
-                th = threading.Thread(
-                    target=self._run_task,
-                    args=(stage, t, stages, errors, stats_sink,
-                          collective_edges),
-                    name=f"task-{f.id}.{t}",
-                    daemon=True,
-                )
-                threads.append(th)
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join(timeout=600)
-        hung = [th.name for th in threads if th.is_alive()]
+        if self.session.task_scheduler == "TIME_SHARING":
+            hung = self._run_time_sharing(
+                fragments, stages, errors, stats_sink, collective_edges)
+        else:
+            threads: list[threading.Thread] = []
+            for f in fragments:
+                stage = stages[f.id]
+                for t in range(stage.task_count):
+                    th = threading.Thread(
+                        target=self._run_task,
+                        args=(stage, t, stages, errors, stats_sink,
+                              collective_edges),
+                        name=f"task-{f.id}.{t}",
+                        daemon=True,
+                    )
+                    threads.append(th)
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=600)
+            hung = [th.name for th in threads if th.is_alive()]
         if errors or hung:
             for s in stages.values():
                 for b in s.buffers:
@@ -196,46 +208,95 @@ class DistributedQueryRunner:
         return QueryResult(names, ColumnBatch(names, [
             Column(t, np.empty(0, t.storage_dtype)) for t in types]))
 
+    def _build_task(self, stage: _Stage, task_index: int,
+                    stages: dict[int, "_Stage"],
+                    stats_sink: Optional[list],
+                    collective: dict) -> tuple[list, Optional[QueryStats]]:
+        f = stage.fragment
+        clients = {
+            src: (collective[src] if src in collective
+                  else ExchangeClient(stages[src].buffers, task_index))
+            for src in f.source_fragments
+        }
+        planner = LocalPlanner(
+            self.catalog,
+            splits_per_node=self.session.splits_per_node,
+            node_count=self.worker_count,
+            task_index=task_index,
+            task_count=stage.task_count,
+            remote_clients=clients,
+            dynamic_filtering=self.session.dynamic_filtering,
+            hbm_limit_bytes=self.session.hbm_limit_bytes,
+        )
+        local = planner.plan(f.root)
+        # swap the collector for the task's output sink
+        if f.id in collective:
+            from .collective_exchange import CollectiveOutputSink
+
+            sink = CollectiveOutputSink(collective[f.id], task_index)
+        else:
+            sink = PartitionedOutputSink(
+                stage.buffers[task_index],
+                f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
+                f.output_keys, serde=self.session.exchange_serde)
+        local.pipelines[-1][-1] = sink
+        stats = None
+        if stats_sink is not None:
+            stats = QueryStats(label=f"fragment {f.id} task {task_index}:")
+            stats_sink.append(stats)  # list.append is thread-safe
+        return local.pipelines, stats
+
+    def _run_time_sharing(self, fragments, stages, errors, stats_sink,
+                          collective) -> list[str]:
+        """Schedule every task on a bounded MLFQ executor
+        (exec/executor.py); returns the names of tasks that never finished."""
+        import time as _time
+
+        from ..exec.executor import TimeSharingTaskExecutor
+
+        executor = TimeSharingTaskExecutor(self.session.executor_workers)
+        try:
+            handles = []
+            for f in fragments:
+                stage = stages[f.id]
+                for t in range(stage.task_count):
+                    pipelines, stats = self._build_task(
+                        stage, t, stages, stats_sink, collective)
+                    handles.append((f, t, executor.submit(pipelines, stats)))
+            # poll every handle so the FIRST failure aborts all buffers
+            # immediately (matching THREADS-mode fail-fast)
+            deadline = _time.monotonic() + 600
+            pending = list(range(len(handles)))
+            while pending and _time.monotonic() < deadline:
+                still = []
+                for i in pending:
+                    f, t, h = handles[i]
+                    if not h.done.is_set():
+                        still.append(i)
+                        continue
+                    if h.error is not None:
+                        errors.append(h.error)
+                        for s in stages.values():
+                            for b in s.buffers:
+                                b.abort()
+                        for ex in collective.values():
+                            ex.abort()
+                if len(still) == len(pending):
+                    _time.sleep(0.02)
+                pending = still
+            return [f"task-{handles[i][0].id}.{handles[i][1]}"
+                    for i in pending]
+        finally:
+            executor.shutdown()
+
     def _run_task(self, stage: _Stage, task_index: int,
                   stages: dict[int, "_Stage"], errors: list,
                   stats_sink: Optional[list] = None,
                   collective: Optional[dict] = None) -> None:
         try:
-            f = stage.fragment
-            collective = collective or {}
-            clients = {
-                src: (collective[src] if src in collective
-                      else ExchangeClient(stages[src].buffers, task_index))
-                for src in f.source_fragments
-            }
-            planner = LocalPlanner(
-                self.catalog,
-                splits_per_node=self.session.splits_per_node,
-                node_count=self.worker_count,
-                task_index=task_index,
-                task_count=stage.task_count,
-                remote_clients=clients,
-                dynamic_filtering=self.session.dynamic_filtering,
-                hbm_limit_bytes=self.session.hbm_limit_bytes,
-            )
-            local = planner.plan(f.root)
-            # swap the collector for the task's output sink
-            if f.id in collective:
-                from .collective_exchange import CollectiveOutputSink
-
-                sink = CollectiveOutputSink(collective[f.id], task_index)
-            else:
-                sink = PartitionedOutputSink(
-                    stage.buffers[task_index],
-                    f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
-                    f.output_keys, serde=self.session.exchange_serde)
-            local.pipelines[-1][-1] = sink
-            stats = None
-            if stats_sink is not None:
-                stats = QueryStats(
-                    label=f"fragment {f.id} task {task_index}:")
-                stats_sink.append(stats)  # list.append is thread-safe
-            run_pipelines(local.pipelines, stats)
+            pipelines, stats = self._build_task(
+                stage, task_index, stages, stats_sink, collective or {})
+            run_pipelines(pipelines, stats)
         except BaseException as e:  # noqa: BLE001 — surfaced to coordinator
             errors.append(e)
             # unblock every sibling immediately: producers stuck in enqueue

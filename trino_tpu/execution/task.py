@@ -64,6 +64,11 @@ def _partition_key_tuple(c: Column):
 
 
 class RemoteExchangeSourceOperator(Operator):
+    # blocking=True: wait in place for upstream pages (thread-per-task mode).
+    # The time-sharing executor flips this off so a waiting consumer parks
+    # (yields its worker) instead of pinning it.
+    blocking = True
+
     def __init__(self, client: ExchangeClient):
         self.client = client
         self.input_done = True
@@ -74,6 +79,9 @@ class RemoteExchangeSourceOperator(Operator):
     def get_output(self) -> Optional[ColumnBatch]:
         if self._closed:
             return None
+        if not self.blocking:
+            page = self.client.poll(timeout=0)
+            return maybe_deserialize(page) if page is not None else None
         # block until a page or all upstream producers finish; the driver
         # treats a None from a non-finished source as "try again"
         deadline = time.monotonic() + 300.0

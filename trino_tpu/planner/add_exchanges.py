@@ -36,6 +36,7 @@ from .plan import (
     Output,
     PlanNode,
     Project,
+    Replicate,
     SemiJoin,
     Sort,
     TableScan,
@@ -155,7 +156,7 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         src = _visit(node.source, single=True)
         return _replace_source(node, src)
 
-    if isinstance(node, (Filter, Project)):
+    if isinstance(node, (Filter, Project, Replicate)):
         src = _visit(node.source, single=single)
         return _replace_source(node, src)
 

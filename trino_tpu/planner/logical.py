@@ -43,6 +43,7 @@ from .plan import (
     Output,
     PlanNode,
     Project,
+    Replicate,
     SemiJoin,
     Sort,
     SortKey,
@@ -306,10 +307,10 @@ class LogicalPlanner:
                                 tuple(range(len(names))), ())
                 rel = RelationPlan(agg, [None] * len(names))
             return rel
-        if not op.distinct:
-            raise AnalysisError(f"{op.op} ALL not yet supported")
 
-        # INTERSECT / EXCEPT [DISTINCT]: tag each side, count per group
+        # INTERSECT / EXCEPT [ALL]: tag each side, count per group.  The
+        # DISTINCT variants filter on the counts; the ALL variants replicate
+        # each group min(l,r) / max(l-r, 0) times (multiset semantics).
         w = len(names)
         tagged = []
         for si, s in enumerate(sides):
@@ -324,6 +325,21 @@ class LogicalPlanner:
         lc = InputRef(BIGINT, w)
         rc = InputRef(BIGINT, w + 1)
         zero = Literal(BIGINT, 0)
+        if not op.distinct:
+            if op.op == "INTERSECT":
+                count_ir = Call(BIGINT, "least", (lc, rc))
+            else:  # EXCEPT ALL
+                count_ir = Call(BIGINT, "greatest",
+                                (Call(BIGINT, "subtract", (lc, rc)), zero))
+            counted = Project(
+                names + ("_n",), tuple(types) + (BIGINT,), agg,
+                tuple(InputRef(t, i) for i, t in enumerate(types))
+                + (count_ir,))
+            repl = Replicate(counted.output_names, counted.output_types,
+                             counted, w)
+            proj = Project(names, tuple(types), repl,
+                           tuple(InputRef(t, i) for i, t in enumerate(types)))
+            return RelationPlan(proj, [None] * len(names))
         if op.op == "INTERSECT":
             pred = Call(BOOLEAN, "$and", (Call(BOOLEAN, "gt", (lc, zero)),
                                           Call(BOOLEAN, "gt", (rc, zero))))

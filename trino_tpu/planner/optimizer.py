@@ -33,6 +33,7 @@ from .plan import (
     Output,
     PlanNode,
     Project,
+    Replicate,
     SemiJoin,
     Sort,
     TableScan,
@@ -73,26 +74,99 @@ def _refs(e: RowExpression) -> set[int]:
     return {x.index for x in walk(e) if isinstance(x, InputRef)}
 
 
+def _channel_ndv(node: PlanNode, ch: int, catalog: Catalog) -> Optional[float]:
+    """Distinct-value estimate for an output channel, traced down identity
+    projections/filters to a TableScan column (the NDV half of Trino's
+    StatsCalculator — cost/ScalarStatsCalculator + table stats)."""
+    while True:
+        if isinstance(node, TableScan):
+            stats = catalog.connector(node.catalog).get_table_statistics(node.table)
+            return stats.ndv.get(node.columns[ch])
+        if isinstance(node, Filter):
+            node = node.source
+            continue
+        if isinstance(node, Project):
+            e = node.expressions[ch]
+            if isinstance(e, InputRef):
+                node, ch = node.source, e.index
+                continue
+            return None
+        if isinstance(node, Join):
+            lw = len(node.left.output_types)
+            if ch < lw:
+                node = node.left
+            else:
+                node, ch = node.right, ch - lw
+            continue
+        if isinstance(node, SemiJoin):
+            if ch < len(node.source.output_types):
+                node = node.source
+                continue
+            return None
+        return None
+
+
+def _conjunct_selectivity(c: RowExpression, source: PlanNode,
+                          catalog: Catalog) -> float:
+    """Per-predicate selectivity from column NDV when available (mirrors
+    cost/FilterStatsCalculator's equality/range rules), 0.3 fallback."""
+    if isinstance(c, Call) and c.name == "eq":
+        for a, b in (c.args, reversed(c.args)):
+            if isinstance(a, InputRef) and isinstance(b, Literal):
+                ndv = _channel_ndv(source, a.index, catalog)
+                if ndv:
+                    return 1.0 / ndv
+        return 0.1
+    if isinstance(c, Call) and c.name == "$in":
+        col = c.args[0]
+        if isinstance(col, InputRef):
+            ndv = _channel_ndv(source, col.index, catalog)
+            if ndv:
+                return min(1.0, (len(c.args) - 1) / ndv)
+        return 0.2
+    if isinstance(c, Call) and c.name in ("lt", "le", "gt", "ge"):
+        return 0.4  # one-sided range (BETWEEN splits into two of these)
+    if isinstance(c, Call) and c.name == "$like":
+        return 0.25
+    return 0.3
+
+
 def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
     if isinstance(node, TableScan):
         stats = catalog.connector(node.catalog).get_table_statistics(node.table)
         r = stats.row_count
         return r if r == r else 10_000.0  # NaN check
     if isinstance(node, Filter):
-        n = len(node.predicate.args) if (
-            isinstance(node.predicate, Call) and node.predicate.name == "$and"
-        ) else 1
-        return estimate_rows(node.source, catalog) * (0.3 ** n)
+        sel = 1.0
+        for c in _split_and(node.predicate):
+            sel *= _conjunct_selectivity(c, node.source, catalog)
+        return estimate_rows(node.source, catalog) * max(sel, 1e-9)
     if isinstance(node, Project):
         return estimate_rows(node.source, catalog)
     if isinstance(node, Aggregate):
         src = estimate_rows(node.source, catalog)
-        return max(1.0, src * 0.1) if node.group_keys else 1.0
+        if not node.group_keys:
+            return 1.0
+        groups = 1.0
+        known = False
+        for k in node.group_keys:
+            ndv = _channel_ndv(node.source, k, catalog)
+            if ndv:
+                groups *= ndv
+                known = True
+        if known:
+            return max(1.0, min(groups, src))
+        return max(1.0, src * 0.1)
     if isinstance(node, Join):
         l = estimate_rows(node.left, catalog)
         r = estimate_rows(node.right, catalog)
         if not node.left_keys:
             return l * r if node.join_type == "CROSS" else l
+        # |L ⋈ R| ≈ |L||R| / max(ndv(lk), ndv(rk)) (textbook equi-join)
+        lnd = _channel_ndv(node.left, node.left_keys[0], catalog)
+        rnd = _channel_ndv(node.right, node.right_keys[0], catalog)
+        if lnd and rnd:
+            return max(1.0, l * r / max(lnd, rnd))
         return max(l, r)
     if isinstance(node, SemiJoin):
         return estimate_rows(node.source, catalog)
@@ -192,7 +266,7 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
         return out, mapping
 
     if isinstance(node, (Sort, TopN, Limit, TableWriter, Exchange,
-                         DistinctLimit)):
+                         DistinctLimit, Replicate)):
         child, m = _rewrite(node.source, catalog)
         kwargs = dict(source=child, output_names=child.output_names,
                       output_types=child.output_types)
@@ -200,6 +274,8 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
             kwargs["keys"] = tuple(replace(k, channel=m[k.channel]) for k in node.keys)
         if isinstance(node, Exchange):
             kwargs["partition_keys"] = tuple(m[k] for k in node.partition_keys)
+        if isinstance(node, Replicate):
+            kwargs["count_channel"] = m[node.count_channel]
         return replace(node, **kwargs), m
 
     if isinstance(node, Window):
@@ -685,6 +761,13 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
         for new, old in enumerate(kept):
             m[old] = new
         return out, m
+
+    if isinstance(node, Replicate):
+        child, cm = _prune(node.source, set(needed) | {node.count_channel})
+        return replace(node, source=child,
+                       output_names=child.output_names,
+                       output_types=child.output_types,
+                       count_channel=cm[node.count_channel]), cm
 
     if isinstance(node, (Limit, Exchange, TableWriter)):
         if isinstance(node, TableWriter):

@@ -22,7 +22,7 @@ __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
-    "Window", "WindowFunc", "Union", "plan_text",
+    "Window", "WindowFunc", "Union", "Replicate", "plan_text",
 ]
 
 
@@ -270,6 +270,23 @@ class Union(PlanNode):
 
     def label(self) -> str:
         return f"Union[{len(self.sources)} inputs]"
+
+
+@dataclass(frozen=True)
+class Replicate(PlanNode):
+    """Emit each input row ``count_channel`` times (0 drops it).  The row-
+    expansion piece of INTERSECT ALL / EXCEPT ALL lowering (reference:
+    SetOperationNodeTranslator's mark/count strategy feeding row expansion)."""
+
+    source: PlanNode = None
+    count_channel: int = -1  # BIGINT input channel holding the repeat count
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return f"Replicate[x#{self.count_channel}]"
 
 
 @dataclass(frozen=True)

@@ -61,6 +61,10 @@ class Session:
     # (stage-by-stage spooled exchange + per-task retry)
     retry_policy: str = "NONE"
     task_retry_attempts: int = 2
+    # THREADS = a thread per task; TIME_SHARING = bounded worker pool with
+    # MLFQ quanta (TimeSharingTaskExecutor)
+    task_scheduler: str = "THREADS"
+    executor_workers: int = 4
 
 
 class StandaloneQueryRunner:

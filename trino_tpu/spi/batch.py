@@ -368,14 +368,33 @@ class ColumnBatch:
 
 def pad_to_bucket(batch: ColumnBatch) -> ColumnBatch:
     """Pad a dense batch to its power-of-two row bucket, marking the padding
-    dead in ``live``.  Host-side (scans produce numpy); the jitted pipeline
-    transfers the stable-shaped arrays to device once per batch."""
+    dead in ``live``.  A batch that already carries a ``live`` mask is
+    already bucket-shaped (device-pinned tables / jitted pipeline output):
+    passed through untouched.  Device-resident columns pad with device ops
+    (async, no host round trip); host columns pad in numpy."""
+    if batch.live is not None:
+        return batch
     n = batch.num_rows
     cap = round_up_pow2(n)
     if cap == n or n == 0:
         return batch
-    assert batch.live is None, "pad_to_bucket on an already-masked batch"
     pad = cap - n
+    on_device = any(not isinstance(c.data, np.ndarray) for c in batch.columns)
+    if on_device:
+        import jax.numpy as jnp
+
+        cols = []
+        for c in batch.columns:
+            data = jnp.concatenate(
+                [jnp.asarray(c.data), jnp.zeros(pad, jnp.asarray(c.data).dtype)])
+            valid = None
+            if c.valid is not None:
+                valid = jnp.concatenate(
+                    [jnp.asarray(c.valid), jnp.zeros(pad, jnp.bool_)])
+            cols.append(Column(c.type, data, valid, c.dictionary))
+        live = jnp.concatenate(
+            [jnp.ones(n, jnp.bool_), jnp.zeros(pad, jnp.bool_)])
+        return ColumnBatch(batch.names, cols, live)
     cols = []
     for c in batch.columns:
         data = np.asarray(c.data)

@@ -1,0 +1,70 @@
+"""DDL breadth: CREATE TABLE (columns), DROP TABLE [IF EXISTS], INSERT,
+DELETE (reference: metadata/MetadataManager + SqlBase.g4 statement rules)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return StandaloneQueryRunner(
+        default_catalog(scale_factor=0.01),
+        session=Session(default_catalog="memory"))
+
+
+def test_create_insert_select_drop(runner):
+    runner.execute(
+        "create table t (id bigint, name varchar, price decimal(10,2))")
+    assert runner.execute("show columns from t").rows() == [
+        ("id bigint",), ("name varchar",), ("price decimal(10,2)",)]
+    runner.execute("insert into t select n_nationkey, n_name, 1.50 "
+                   "from tpch.nation where n_regionkey = 1")
+    rows = runner.execute("select count(*), sum(price) from t").rows()
+    assert rows[0][0] == 5
+    assert float(rows[0][1]) == 7.5
+    runner.execute("drop table t")
+    with pytest.raises(Exception):
+        runner.execute("select * from t")
+
+
+def test_drop_if_exists(runner):
+    runner.execute("drop table if exists nope")  # no error
+    with pytest.raises(Exception):
+        runner.execute("drop table nope")
+
+
+def test_delete_where(runner):
+    runner.execute("create table d as select n_nationkey, n_regionkey "
+                   "from tpch.nation")
+    out = runner.execute("delete from d where n_regionkey = 1").rows()
+    assert out[0][0] == 5  # 5 nations per region
+    assert runner.execute("select count(*) from d").rows() == [(20,)]
+    # NULL predicate keeps rows (three-valued semantics)
+    runner.execute("delete from d where cast(null as boolean)")
+    assert runner.execute("select count(*) from d").rows() == [(20,)]
+    # unconditional delete empties the table
+    out = runner.execute("delete from d").rows()
+    assert out[0][0] == 20
+    assert runner.execute("select count(*) from d").rows() == [(0,)]
+
+
+def test_delete_distributed():
+    catalog = default_catalog(scale_factor=0.01)
+    d = DistributedQueryRunner(
+        catalog, worker_count=2,
+        session=Session(node_count=2, default_catalog="memory"))
+    d.execute("create table dd as select o_orderkey, o_totalprice "
+              "from tpch.orders")
+    deleted = d.execute("delete from dd where o_totalprice < 100000").rows()
+    remaining = d.execute("select count(*) from dd").rows()[0][0]
+    assert deleted[0][0] + remaining == 15000  # orders rows at SF0.01
+    assert d.execute(
+        "select count(*) from dd where o_totalprice < 100000").rows() == [(0,)]
+
+
+def test_delete_rejected_on_readonly_connector(runner):
+    with pytest.raises(Exception, match="DELETE|sink"):
+        runner.execute("delete from tpch.nation")

@@ -97,6 +97,7 @@ class MemoryConnector(Connector):
         with self._lock:
             self._schemas.pop(table, None)
             self._data.pop(table, None)
+            self._pinned_rows.pop(table, None)
 
     def get_splits(self, table: str, splits_per_node: int, node_count: int) -> list[Split]:
         with self._lock:
@@ -124,6 +125,9 @@ class MemoryConnector(Connector):
         with self._lock:
             for staged in fragments:
                 self._data[table].extend(staged)
+                if table in self._pinned_rows:
+                    self._pinned_rows[table] += sum(
+                        b.live_count for b in staged)
 
     def pin_to_device(self, table: str) -> None:
         """Make a table device-resident: batches become bucket-padded jax
@@ -137,42 +141,28 @@ class MemoryConnector(Connector):
 
         from ..spi.batch import Column, ColumnBatch, round_up_pow2
 
+        from ..spi.batch import pad_to_bucket
+
         with self._lock:
             batches = self._data.get(table, [])
             total_rows = 0
             pinned = []
             for b in batches:
-                b = b.compact()
-                total_rows += b.num_rows
-                if b.live is None:
+                b = pad_to_bucket(b.compact())
+                total_rows += b.live_count
+                live = b.live
+                if live is None:
                     # a live mask marks the batch device-pinned downstream
                     # (ScanOperator skips host work for it) — attach an
                     # all-ones mask even when no padding was needed
-                    b = ColumnBatch(b.names, b.columns,
-                                    _np.ones(b.num_rows, _np.bool_))
-                n = len(b.columns[0]) if b.columns else 0
-                cap = round_up_pow2(n)
-                pad = cap - n
-                cols = []
-                for c in b.columns:
-                    data = _np.asarray(c.data)
-                    if pad:
-                        data = _np.concatenate(
-                            [data, _np.zeros(pad, data.dtype)])
-                    valid = None
-                    if c.valid is not None:
-                        valid = _np.asarray(c.valid)
-                        if pad:
-                            valid = _np.concatenate(
-                                [valid, _np.zeros(pad, _np.bool_)])
-                    cols.append(Column(
-                        c.type, jax.device_put(jnp.asarray(data)),
-                        None if valid is None
-                        else jax.device_put(jnp.asarray(valid)),
-                        c.dictionary))
-                live = _np.asarray(b.live)
-                if pad:
-                    live = _np.concatenate([live, _np.zeros(pad, _np.bool_)])
+                    live = _np.ones(b.num_rows, _np.bool_)
+                cols = [
+                    Column(c.type, jax.device_put(jnp.asarray(c.data)),
+                           None if c.valid is None
+                           else jax.device_put(jnp.asarray(c.valid)),
+                           c.dictionary)
+                    for c in b.columns
+                ]
                 pinned.append(ColumnBatch(
                     b.names, cols, jax.device_put(jnp.asarray(live))))
             self._data[table] = pinned

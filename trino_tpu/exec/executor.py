@@ -33,6 +33,13 @@ _LEVELS = (0.0, 0.5, 2.0, 10.0, 60.0)
 _QUANTUM_S = 0.25
 
 
+# blocked tasks park BELOW every working level: a consumer waiting on its
+# producer must never outrank it on the strict-priority heap (starvation
+# observed otherwise: level-0 blocked consumers churned ahead of level-1+
+# producers)
+_BLOCKED_LEVEL = len(_LEVELS)
+
+
 def _level_of(elapsed: float) -> int:
     lvl = 0
     for i, t in enumerate(_LEVELS):
@@ -127,9 +134,11 @@ class TimeSharingTaskExecutor:
             if status == "finished":
                 continue
             if status == "blocked":
-                # park briefly: the input this task waits on is produced by
-                # another task that now gets the worker
+                # park at the bottom of the heap: the producer this task
+                # waits on must win every pop until it makes progress
                 time.sleep(0.001)
+                self._enqueue(handle, _BLOCKED_LEVEL)
+                continue
             self._enqueue(handle, _level_of(handle.elapsed))
 
     def shutdown(self) -> None:

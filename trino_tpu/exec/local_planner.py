@@ -67,7 +67,8 @@ class LocalPlanner:
                  node_count: int = 1, task_index: int = 0,
                  task_count: int = 1, remote_clients=None,
                  dynamic_filtering: bool = True,
-                 hbm_limit_bytes: int = 16 << 30):
+                 hbm_limit_bytes: int = 16 << 30,
+                 spill_to_disk_bytes: int = 0):
         self.catalog = catalog
         self.splits_per_node = splits_per_node
         self.node_count = node_count
@@ -79,7 +80,7 @@ class LocalPlanner:
         self.dynamic_filtering = dynamic_filtering
         # per-task HBM pool: blocking operators reserve buffered device
         # bytes as revocable memory (exec/revoking.py)
-        self.memory = TaskMemoryContext(hbm_limit_bytes)
+        self.memory = TaskMemoryContext(hbm_limit_bytes, spill_to_disk_bytes)
         self.pipelines: list[list[Operator]] = []
 
     def plan(self, root: P.PlanNode) -> LocalExecutionPlan:
@@ -234,12 +235,15 @@ class LocalPlanner:
             chain = self._chain(node.source)
             conn = self.catalog.connector(node.catalog)
             try:
-                conn.get_table_schema(node.table)
+                schema = conn.get_table_schema(node.table)
             except KeyError:  # CTAS: create target from source schema
                 from ..spi.connector import ColumnSchema, TableSchema
-                conn.create_table(TableSchema(node.table, tuple(
+                schema = TableSchema(node.table, tuple(
                     ColumnSchema(n, t) for n, t in
-                    zip(node.source.output_names, node.source.output_types))))
+                    zip(node.source.output_names, node.source.output_types)))
+                conn.create_table(schema)
+            # INSERT maps select output to table columns by POSITION
+            chain.append(RenameOperator([c.name for c in schema.columns]))
             sink = conn.create_page_sink(node.table)
             chain.append(TableWriterOperator(
                 sink,

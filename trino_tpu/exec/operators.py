@@ -399,6 +399,7 @@ class BufferedInputMixin:
             from .revoking import batch_device_residual
 
             self._mem.update(self, batch_device_residual(self))
+            self._maybe_spill_to_disk()
 
     def revoke_memory(self) -> int:
         from .revoking import batch_device_nbytes
@@ -421,6 +422,43 @@ class BufferedInputMixin:
         self._batches = []
         if self._mem is not None:
             self._mem.update(self, 0)
+
+    def _maybe_spill_to_disk(self) -> None:
+        """Third tier: host-buffered batches exceeding the session's disk
+        threshold go to a serde spill file (exec/spill.py)."""
+        limit = getattr(self._mem, "spill_to_disk_bytes", 0) if self._mem else 0
+        if not limit:
+            return
+        batches = getattr(self, "_batches", None)
+        if not batches:
+            return
+        host_bytes = sum(
+            b.nbytes for b in batches if isinstance(b.columns[0].data, np.ndarray)
+        ) if batches and batches[0].columns else 0
+        if host_bytes <= limit:
+            return
+        from .spill import Spiller
+
+        if getattr(self, "_spiller", None) is None:
+            self._spiller = Spiller()
+        keep = []
+        for b in batches:
+            if b.columns and isinstance(b.columns[0].data, np.ndarray):
+                self._spiller.spill(b)
+            else:
+                keep.append(b)
+        self._batches = keep
+
+    def buffered_batches(self) -> list:
+        """The operator's full input: disk-spilled pages restored first,
+        then the in-memory tail (finish-time accessor)."""
+        spiller = getattr(self, "_spiller", None)
+        if spiller is not None:
+            restored = list(spiller.read_back())
+            spiller.close()
+            self._spiller = None
+            self._batches = restored + self._batches
+        return self._batches
 
 
 # ---------------------------------------------------------------------------
@@ -582,7 +620,7 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
 
     def _compute(self) -> ColumnBatch:
         nk = len(self.group_keys)
-        if not self._batches:
+        if not self.buffered_batches():
             return self._empty_result(nk)
         inp = _concat_device(self._batches)
         live = inp.live  # None = all rows real
@@ -794,7 +832,7 @@ class JoinBuildSink(BufferedInputMixin, Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
-        if self._batches:
+        if self.buffered_batches():
             batch = ColumnBatch.concat(self._batches)
         else:
             batch = ColumnBatch(self.names, [
@@ -1106,7 +1144,7 @@ class WindowOperator(BufferedInputMixin, Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
-        if not self._batches:
+        if not self.buffered_batches():
             self._result = ColumnBatch(
                 self.output_names,
                 [Column(t, np.empty(0, t.storage_dtype))
@@ -1181,7 +1219,7 @@ class SortOperator(BufferedInputMixin, Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
-        if not self._batches:
+        if not self.buffered_batches():
             self._emitted = True
             return
         inp = ColumnBatch.concat(self._batches)
@@ -1220,7 +1258,7 @@ class TopNOperator(SortOperator):
         self.account_memory()
 
     def _shrink(self) -> None:
-        inp = ColumnBatch.concat(self._batches)
+        inp = ColumnBatch.concat(self.buffered_batches())
         perm = K.sort_perm(_sort_key_tuples(inp, self.keys))
         best = inp.take(np.asarray(perm)[: self.count])
         self._batches = [best]
@@ -1299,7 +1337,7 @@ class DistinctLimitOperator(BufferedInputMixin, Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
-        if not self._batches:
+        if not self.buffered_batches():
             self._emitted = True
             return
         inp = ColumnBatch.concat(self._batches)

@@ -52,8 +52,11 @@ class TaskMemoryContext:
     itself, and only then raises ExceededMemoryLimitError.
     """
 
-    def __init__(self, hbm_limit_bytes: int):
+    def __init__(self, hbm_limit_bytes: int, spill_to_disk_bytes: int = 0):
         self.pool = MemoryPool("hbm", hbm_limit_bytes)
+        # per-operator HOST-buffer threshold for the disk spill tier
+        # (0 = disabled; exec/spill.py)
+        self.spill_to_disk_bytes = spill_to_disk_bytes
         self.root = AggregatedMemoryContext(pool=self.pool, revocable=True)
         self._locals: dict[int, object] = {}
         self._ops: dict[int, Revocable] = {}

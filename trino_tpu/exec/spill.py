@@ -1,0 +1,60 @@
+"""Disk spill tier: serialized pages in temp files.
+
+The second spill tier below HBM->host eviction (exec/revoking.py):
+when an operator's HOST-buffered bytes exceed the session's
+``spill_to_disk_bytes``, buffered batches are written as compressed serde
+pages (execution/serde.py) to a spill file and read back at finish.
+Mirrors the reference's FileSingleStreamSpiller.java:57 +
+GenericSpillerFactory (one file per spilling operator, pages appended
+length-prefixed, eagerly deleted on close).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+from typing import Iterator, Optional
+
+from ..spi.batch import ColumnBatch
+from ..execution.serde import deserialize_batch, serialize_batch
+
+__all__ = ["Spiller"]
+
+
+class Spiller:
+    """Append-only page spill file for one operator."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        self._dir = spill_dir
+        self._file = None
+        self.pages_spilled = 0
+        self.bytes_spilled = 0
+
+    def spill(self, batch: ColumnBatch) -> None:
+        if self._file is None:
+            fd, path = tempfile.mkstemp(prefix="trino-tpu-spill-",
+                                        suffix=".bin", dir=self._dir)
+            self._file = os.fdopen(fd, "w+b")
+            os.unlink(path)  # anonymous: vanishes with the fd on any exit
+        page = serialize_batch(batch)
+        self._file.write(struct.pack("<I", len(page)))
+        self._file.write(page)
+        self.pages_spilled += 1
+        self.bytes_spilled += len(page)
+
+    def read_back(self) -> Iterator[ColumnBatch]:
+        if self._file is None:
+            return
+        self._file.seek(0)
+        while True:
+            header = self._file.read(4)
+            if len(header) < 4:
+                break
+            (n,) = struct.unpack("<I", header)
+            yield deserialize_batch(self._file.read(n))
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None

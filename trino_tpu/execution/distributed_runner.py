@@ -89,6 +89,14 @@ class DistributedQueryRunner:
                 stmt.table, self.session.default_catalog)
             return text_result(
                 "Column", [f"{c.name} {c.type}" for c in schema.columns])
+        from ..runner import execute_ddl
+
+        ddl = execute_ddl(
+            stmt, self.catalog, self.session.default_catalog,
+            lambda st: self._execute_subplan(
+                fragment_plan(self._plan_stmt(st)), None))
+        if ddl is not None:
+            return ddl
         subplan = fragment_plan(self._plan_stmt(stmt))
         return self._execute_subplan(subplan, None)
 

@@ -33,6 +33,81 @@ def text_result(name: str, lines: list[str]) -> "QueryResult":
         [name], [Column.from_values(VARCHAR, lines)]))
 
 
+def count_result(name: str, n: int) -> "QueryResult":
+    from .spi.types import BIGINT
+
+    return QueryResult([name], ColumnBatch(
+        [name], [Column(BIGINT, np.array([n], np.int64))]))
+
+
+def execute_ddl(stmt, catalog, default_catalog_name: str,
+                run_select) -> Optional["QueryResult"]:
+    """Metadata statements shared by both runners (CREATE TABLE with
+    columns, DROP TABLE, DELETE).  Returns None for non-DDL statements.
+    Reference: metadata/MetadataManager create/drop, and DELETE planned as
+    scan+filter+rewrite (the simple connectors have no row-id deletes)."""
+    from .spi.connector import ColumnSchema, TableSchema
+    from .spi.types import parse_type
+
+    if isinstance(stmt, ast.CreateTable):
+        cat, table = _split_name(stmt.table, default_catalog_name)
+        conn = catalog.connector(cat)
+        conn.create_table(TableSchema(table, tuple(
+            ColumnSchema(n, parse_type(t)) for n, t in stmt.columns)))
+        return count_result("rows", 0)
+    if isinstance(stmt, ast.DropTable):
+        cat, table = _split_name(stmt.table, default_catalog_name)
+        conn = catalog.connector(cat)
+        try:
+            conn.get_table_schema(table)
+        except KeyError:
+            if stmt.if_exists:
+                return count_result("rows", 0)
+            raise
+        conn.drop_table(table)
+        return count_result("rows", 0)
+    if isinstance(stmt, ast.Delete):
+        cat, table, schema = catalog.resolve_table(
+            stmt.table, default_catalog_name)
+        conn = catalog.connector(cat)
+        try:  # capability probe BEFORE mutating anything
+            conn.create_page_sink(table)
+        except NotImplementedError:
+            raise ValueError(f"connector {cat} does not support DELETE")
+        stats = conn.get_table_statistics(table)
+        before = int(stats.row_count) if stats.row_count == stats.row_count else None
+        if before is None:  # no stats: count the table first
+            cq = ast.Query(ast.QuerySpec(
+                (ast.SelectItem(ast.FunctionCall("count", (), is_star=True)),),
+                False, ast.Table(f"{cat}.{table}"), None, (), None))
+            before = int(run_select(ast.QueryStatement(cq)).rows()[0][0])
+        # rows to KEEP: NOT coalesce(pred, false) — NULL predicates keep
+        if stmt.where is None:
+            keep_where = ast.BooleanLiteral(False)
+        else:
+            keep_where = ast.Not(ast.FunctionCall(
+                "coalesce", (stmt.where, ast.BooleanLiteral(False))))
+        q = ast.Query(ast.QuerySpec(
+            (ast.SelectItem(None),), False,
+            ast.Table(f"{cat}.{table}"), keep_where, (), None))
+        kept = run_select(ast.QueryStatement(q))
+        conn.drop_table(table)
+        conn.create_table(TableSchema(table, schema.columns))
+        sink = conn.create_page_sink(table)
+        sink.append(kept.batch)
+        conn.finish_insert(table, sink.finish())
+        kept_rows = kept.batch.compact().num_rows
+        return count_result("rows", before - kept_rows)
+    return None
+
+
+def _split_name(name: str, default: str) -> tuple[str, str]:
+    parts = name.split(".")
+    if len(parts) == 1:
+        return default, parts[0]
+    return parts[0], parts[-1]
+
+
 @dataclass
 class QueryResult:
     names: list[str]
@@ -52,6 +127,9 @@ class Session:
     dynamic_filtering: bool = True
     # per-task HBM pool limit for blocking operators' buffered device bytes
     hbm_limit_bytes: int = 16 << 30
+    # per-operator host-buffer bytes before the disk spill tier engages
+    # (0 = disabled)
+    spill_to_disk_bytes: int = 0
     # REPARTITION edges run as device collectives (all_to_all) when the
     # mesh has enough devices; host exchange is the fallback
     use_collectives: bool = True
@@ -96,6 +174,10 @@ class StandaloneQueryRunner:
                 stmt.table, self.session.default_catalog)
             return text_result(
                 "Column", [f"{c.name} {c.type}" for c in schema.columns])
+        ddl = execute_ddl(stmt, self.catalog, self.session.default_catalog,
+                          lambda st: self._execute_stmt(st, False)[0])
+        if ddl is not None:
+            return ddl
         result, _ = self._execute_stmt(stmt, collect_stats=False)
         return result
 
@@ -110,6 +192,7 @@ class StandaloneQueryRunner:
             node_count=self.session.node_count,
             dynamic_filtering=self.session.dynamic_filtering,
             hbm_limit_bytes=self.session.hbm_limit_bytes,
+            spill_to_disk_bytes=self.session.spill_to_disk_bytes,
         ).plan(plan)
         stats = QueryStats() if collect_stats else None
         run_pipelines(local.pipelines, stats)

@@ -327,6 +327,26 @@ class CreateTableAsSelect(Statement):
 
 
 @dataclass(frozen=True)
+class CreateTable(Statement):
+    """CREATE TABLE t (col type, ...) — columns as (name, type_text)."""
+
+    table: str
+    columns: tuple[tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class DropTable(Statement):
+    table: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Delete(Statement):
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
 class InsertInto(Statement):
     table: str
     query: Query

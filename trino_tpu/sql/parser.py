@@ -58,7 +58,7 @@ KEYWORDS = {
     "quarter", "hour", "minute", "second", "asc", "desc", "nulls", "first",
     "last", "explain", "analyze", "create", "table", "insert", "into",
     "values", "show", "tables", "columns", "describe", "substring", "for",
-    "over",
+    "over", "drop", "delete",
 }
 
 
@@ -165,8 +165,33 @@ class _Parser:
         if self.accept_kw("create"):
             self.expect_kw("table")
             name = self.qualified_name()
+            if self.accept_op("("):
+                cols = []
+                while True:
+                    cname = self.expect_ident()
+                    ctype = self.parse_type_name()
+                    cols.append((cname, ctype))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                return ast.CreateTable(name, tuple(cols))
             self.expect_kw("as")
             return ast.CreateTableAsSelect(name, self.parse_query())
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            if_exists = False
+            save = self.i
+            if self.accept_word("if"):
+                if self.accept_word("exists"):
+                    if_exists = True
+                else:
+                    self.i = save
+            return ast.DropTable(self.qualified_name(), if_exists)
+        if self.accept_kw("delete"):
+            self.expect_kw("from")
+            name = self.qualified_name()
+            where = self.parse_expr() if self.accept_kw("where") else None
+            return ast.Delete(name, where)
         if self.accept_kw("insert"):
             self.expect_kw("into")
             name = self.qualified_name()

@@ -42,8 +42,13 @@ class Catalog:
         return cat, table, schema
 
 
-def default_catalog(scale_factor: float = 0.01) -> Catalog:
-    """Catalog with the standard engine-support connectors registered."""
+def default_catalog(scale_factor: float = 0.01,
+                    file_root: Optional[str] = None) -> Catalog:
+    """Catalog with the standard engine-support connectors registered.
+
+    ``file_root`` anchors the persistent file connector; default is a fresh
+    temp directory per catalog, created lazily on first use."""
+    from .file import FileConnector
     from .memory import BlackholeConnector, MemoryConnector
     from .tpch import TpchConnector
 
@@ -51,4 +56,5 @@ def default_catalog(scale_factor: float = 0.01) -> Catalog:
     cat.register("tpch", TpchConnector(scale_factor))
     cat.register("memory", MemoryConnector())
     cat.register("blackhole", BlackholeConnector())
+    cat.register("file", FileConnector(file_root))
     return cat

@@ -63,6 +63,7 @@ class TaskHandle:
             self.drivers.append(Driver(p, ps))
         self._current = 0
         self.elapsed = 0.0
+        self.blocked_streak = 0
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
 
@@ -134,11 +135,17 @@ class TimeSharingTaskExecutor:
             if status == "finished":
                 continue
             if status == "blocked":
-                # park at the bottom of the heap: the producer this task
-                # waits on must win every pop until it makes progress
+                # sink below the producer this task waits on, deeper with
+                # every consecutive block — but never permanently below
+                # other queries' work (a parked-forever bottom level would
+                # trade intra-query starvation for cross-query starvation)
+                handle.blocked_streak += 1
+                level = min(_BLOCKED_LEVEL,
+                            _level_of(handle.elapsed) + handle.blocked_streak)
                 time.sleep(0.001)
-                self._enqueue(handle, _BLOCKED_LEVEL)
+                self._enqueue(handle, level)
                 continue
+            handle.blocked_streak = 0
             self._enqueue(handle, _level_of(handle.elapsed))
 
     def shutdown(self) -> None:

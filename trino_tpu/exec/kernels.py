@@ -267,6 +267,46 @@ def _reduce_fn(spec: tuple, cap: int):
     return fn
 
 
+_PALLAS_STATE = {"enabled": None}
+
+
+def _pallas_enabled() -> bool:
+    import os
+
+    if _PALLAS_STATE["enabled"] is None:
+        mode = os.environ.get("TRINO_TPU_PALLAS", "1")
+        if mode == "0":
+            _PALLAS_STATE["enabled"] = False
+        else:
+            from ..ops.pallas_kernels import pallas_available
+
+            # compiled kernels only beat XLA on real TPU lanes; interpret
+            # mode is for tests (force with TRINO_TPU_PALLAS=force)
+            _PALLAS_STATE["enabled"] = pallas_available() and (
+                mode == "force" or jax.default_backend() == "tpu")
+    return _PALLAS_STATE["enabled"]
+
+
+def _pallas_f32_sum(perm, gid, cap: int, data, valid):
+    """REAL-sum fast path: blockwise VMEM accumulation instead of XLA's
+    scatter segment_sum (ops/pallas_kernels.py).  Returns (sums, anyvalid)
+    or None when pallas fails (flag flips off, XLA takes over)."""
+    from ..ops import pallas_kernels as PK
+
+    try:
+        interpret = jax.default_backend() != "tpu"
+        vals = jnp.asarray(data)[perm]
+        lv = None if valid is None else jnp.asarray(valid)[perm]
+        s = PK.masked_segment_sum_f32(vals, gid, lv, cap, interpret=interpret)
+        anyv = None
+        if valid is not None:  # the validity bit is one cheap segment_max
+            anyv = jax.ops.segment_max(lv, gid, cap)
+        return s, anyv
+    except Exception:  # noqa: BLE001 — pallas unavailable: permanent fallback
+        _PALLAS_STATE["enabled"] = False
+        return None
+
+
 def grouped_reduce(
     perm,
     gid,
@@ -278,25 +318,39 @@ def grouped_reduce(
     Returns per-agg (values, valid|None) arrays of length num_groups.
     """
     cap = bucket(num_groups)
+    results: list = [None] * len(aggs)
     spec = []
     flat = []
-    for fn, data, valid, dtype, distinct in aggs:
+    xla_slots = []
+    for idx, (fn, data, valid, dtype, distinct) in enumerate(aggs):
+        if (fn == "sum" and data is not None and not distinct
+                and np.dtype(dtype) == np.float32 and cap <= 64
+                and _pallas_enabled()):
+            out = _pallas_f32_sum(jnp.asarray(perm), jnp.asarray(gid), cap,
+                                  data, valid)
+            if out is not None:
+                results[idx] = (out[0][:num_groups],
+                                None if out[1] is None
+                                else out[1][:num_groups])
+                continue
         if fn == "count_star" or data is None:
             spec.append(("count_star", valid is not None, "int64", False))
             if valid is not None:  # live mask: count only live rows
                 flat.append(jnp.asarray(valid))
+            xla_slots.append(idx)
             continue
         spec.append((fn, valid is not None, np.dtype(dtype).str, bool(distinct)))
         flat.append(jnp.asarray(data))
         if valid is not None:
             flat.append(jnp.asarray(valid))
-    outs = _reduce_fn(tuple(spec), cap)(jnp.asarray(perm), jnp.asarray(gid), *flat)
-    result = []
-    for data, valid in outs:
-        d = data[:num_groups]
-        v = None if valid is None else valid[:num_groups]
-        result.append((d, v))
-    return result
+        xla_slots.append(idx)
+    if spec:
+        outs = _reduce_fn(tuple(spec), cap)(
+            jnp.asarray(perm), jnp.asarray(gid), *flat)
+        for idx, (data, valid) in zip(xla_slots, outs):
+            results[idx] = (data[:num_groups],
+                            None if valid is None else valid[:num_groups])
+    return results
 
 
 def group_keys_out(perm, gid, num_groups: int, keys: Sequence[tuple]):

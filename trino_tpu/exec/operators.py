@@ -571,7 +571,12 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
             # decomposes into (sum, sum-of-squares, count) states
             return (a.fn, data, valid, np.float64, a.distinct)
         if a.fn == "sum":
-            dtype = np.float64 if out_t == DOUBLE else np.int64
+            if out_t == DOUBLE:
+                dtype = np.float64
+            elif out_t.name == "real":
+                dtype = np.float32  # f32 lanes: the pallas fast path
+            else:
+                dtype = np.int64
             return ("sum", data, valid, dtype, a.distinct)
         if a.fn == "count":
             return ("count", data, valid, np.int64, a.distinct)

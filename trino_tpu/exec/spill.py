@@ -12,7 +12,6 @@ length-prefixed, eagerly deleted on close).
 from __future__ import annotations
 
 import os
-import struct
 import tempfile
 from typing import Iterator, Optional
 
@@ -32,27 +31,26 @@ class Spiller:
         self.bytes_spilled = 0
 
     def spill(self, batch: ColumnBatch) -> None:
+        from ..execution.serde import write_frame
+
         if self._file is None:
             fd, path = tempfile.mkstemp(prefix="trino-tpu-spill-",
                                         suffix=".bin", dir=self._dir)
             self._file = os.fdopen(fd, "w+b")
             os.unlink(path)  # anonymous: vanishes with the fd on any exit
         page = serialize_batch(batch)
-        self._file.write(struct.pack("<I", len(page)))
-        self._file.write(page)
+        write_frame(self._file, page)
         self.pages_spilled += 1
         self.bytes_spilled += len(page)
 
     def read_back(self) -> Iterator[ColumnBatch]:
+        from ..execution.serde import iter_frames
+
         if self._file is None:
             return
         self._file.seek(0)
-        while True:
-            header = self._file.read(4)
-            if len(header) < 4:
-                break
-            (n,) = struct.unpack("<I", header)
-            yield deserialize_batch(self._file.read(n))
+        for frame in iter_frames(self._file):
+            yield deserialize_batch(frame)
 
     def close(self) -> None:
         if self._file is not None:

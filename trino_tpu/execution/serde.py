@@ -22,7 +22,27 @@ import numpy as np
 from ..spi.batch import Column, ColumnBatch
 from ..spi.types import Type, parse_type
 
-__all__ = ["serialize_batch", "deserialize_batch", "CODEC_NONE", "CODEC_ZLIB"]
+__all__ = ["serialize_batch", "deserialize_batch", "write_frame",
+           "iter_frames", "CODEC_NONE", "CODEC_ZLIB"]
+
+
+def write_frame(f, page: bytes) -> None:
+    """Append one length-prefixed page frame ([u32 LE length][bytes]) —
+    the shared on-disk/wire framing used by the spiller and the file
+    connector (and scanned natively by native/pagefile.cpp)."""
+    f.write(struct.pack("<I", len(page)))
+    f.write(page)
+
+
+def iter_frames(f):
+    """Yield every frame's bytes from a seekable file opened at a frame
+    boundary."""
+    while True:
+        hdr = f.read(4)
+        if len(hdr) < 4:
+            return
+        (n,) = struct.unpack("<I", hdr)
+        yield f.read(n)
 
 _MAGIC = b"TTP1"
 CODEC_NONE = 0

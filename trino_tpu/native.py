@@ -1,0 +1,79 @@
+"""ctypes bindings for the native (C++) runtime pieces.
+
+pybind11 isn't in the image, so the native library (native/pagefile.cpp —
+zlib page framing, validity bitmaps, page-file scanning) binds through
+ctypes.  ``load()`` builds the shared object on first use with the baked-in
+toolchain and caches it next to the source; every caller must handle
+``None`` (pure-Python fallback paths stay correct without the library).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["load", "lib_path"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "pagefile.cpp")
+_SO = os.path.join(_ROOT, "native", "libpagefile.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def lib_path() -> str:
+    return _SO
+
+
+def _build() -> bool:
+    for cc in ("c++", "g++"):
+        try:
+            proc = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", _SO, _SRC, "-lz"],
+                capture_output=True, text=True, timeout=120)
+            if proc.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def load():
+    """The loaded CDLL with typed signatures, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64 = ctypes.c_int64
+        lib.ttp_deflate.argtypes = [u8p, i64, u8p, i64, ctypes.c_int]
+        lib.ttp_deflate.restype = i64
+        lib.ttp_deflate_bound.argtypes = [i64]
+        lib.ttp_deflate_bound.restype = i64
+        lib.ttp_inflate.argtypes = [u8p, i64, u8p, i64]
+        lib.ttp_inflate.restype = i64
+        lib.ttp_pack_bits.argtypes = [u8p, i64, u8p]
+        lib.ttp_pack_bits.restype = None
+        lib.ttp_unpack_bits.argtypes = [u8p, i64, u8p]
+        lib.ttp_unpack_bits.restype = None
+        lib.ttp_scan_frames.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(i64), i64]
+        lib.ttp_scan_frames.restype = i64
+        lib.ttp_read_frame.argtypes = [ctypes.c_char_p, i64, i64, u8p]
+        lib.ttp_read_frame.restype = i64
+        _lib = lib
+        return _lib

@@ -70,9 +70,10 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
         cat, table, schema = catalog.resolve_table(
             stmt.table, default_catalog_name)
         conn = catalog.connector(cat)
-        try:  # capability probe BEFORE mutating anything
-            conn.create_page_sink(table)
-        except NotImplementedError:
+        from .spi.connector import Connector as _BaseConnector
+
+        impl = getattr(type(conn), "create_page_sink", None)
+        if impl is None or impl is _BaseConnector.create_page_sink:
             raise ValueError(f"connector {cat} does not support DELETE")
         stats = conn.get_table_statistics(table)
         before = int(stats.row_count) if stats.row_count == stats.row_count else None
@@ -91,11 +92,31 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
             (ast.SelectItem(None),), False,
             ast.Table(f"{cat}.{table}"), keep_where, (), None))
         kept = run_select(ast.QueryStatement(q))
+        # stage the kept rows FIRST: every risky step (serde, disk) happens
+        # before the original table is touched, so a failed rewrite cannot
+        # destroy data
+        staging = f"__rewrite_{table}"
+        conn.drop_table(staging)
+        conn.create_table(TableSchema(staging, schema.columns))
+        try:
+            sink = conn.create_page_sink(staging)
+            sink.append(kept.batch)
+            conn.finish_insert(staging, sink.finish())
+        except BaseException:
+            conn.drop_table(staging)
+            raise
         conn.drop_table(table)
         conn.create_table(TableSchema(table, schema.columns))
         sink = conn.create_page_sink(table)
-        sink.append(kept.batch)
+        for split in conn.get_splits(staging, 1, 1):
+            src = conn.create_page_source(
+                split, [c.name for c in schema.columns])
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is not None:
+                    sink.append(b)
         conn.finish_insert(table, sink.finish())
+        conn.drop_table(staging)
         kept_rows = kept.batch.compact().num_rows
         return count_result("rows", before - kept_rows)
     return None

@@ -188,7 +188,9 @@ def agg_result_type(fn: str, arg_type: Optional[Type]) -> Type:
     if fn == "sum":
         if isinstance(arg_type, DecimalType):
             return DecimalType(18, arg_type.scale)
-        if arg_type in (DOUBLE,) or (arg_type and arg_type.name == "real"):
+        if arg_type is not None and arg_type.name == "real":
+            return arg_type  # sum(real) -> real (Trino semantics)
+        if arg_type in (DOUBLE,):
             return DOUBLE
         return BIGINT
     return arg_type  # min/max/any_value

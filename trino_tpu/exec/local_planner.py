@@ -110,11 +110,18 @@ class LocalPlanner:
                 CollectiveRepartitionExchange,
                 CollectiveSourceOperator,
             )
-            from ..execution.task import RemoteExchangeSourceOperator
+            from ..execution.task import (
+                MergeSourceOperator,
+                RemoteExchangeSourceOperator,
+            )
 
             client = self.remote_clients[node.fragment_id]
             if isinstance(client, CollectiveRepartitionExchange):
                 return [CollectiveSourceOperator(client, self.task_index)]
+            if isinstance(client, list):  # MERGE: per-producer streams
+                return [MergeSourceOperator(
+                    client, node.sort_keys,
+                    node.output_names, node.output_types)]
             return [RemoteExchangeSourceOperator(client)]
 
         if isinstance(node, P.Filter):

@@ -221,11 +221,17 @@ class DistributedQueryRunner:
                     stats_sink: Optional[list],
                     collective: dict) -> tuple[list, Optional[QueryStats]]:
         f = stage.fragment
-        clients = {
-            src: (collective[src] if src in collective
-                  else ExchangeClient(stages[src].buffers, task_index))
-            for src in f.source_fragments
-        }
+        clients = {}
+        for src in f.source_fragments:
+            if src in collective:
+                clients[src] = collective[src]
+            elif stages[src].fragment.output_kind == "MERGE":
+                # order-preserving gather: one client PER producer so the
+                # merge operator sees each task's sorted stream separately
+                clients[src] = [ExchangeClient([b], task_index)
+                                for b in stages[src].buffers]
+            else:
+                clients[src] = ExchangeClient(stages[src].buffers, task_index)
         planner = LocalPlanner(
             self.catalog,
             splits_per_node=self.session.splits_per_node,

@@ -83,7 +83,7 @@ class _Fragmenter:
             sources.append(child.fragment.id)
             children.append(child)
             return RemoteSource(node.output_names, node.output_types,
-                               child.fragment.id, node.kind)
+                               child.fragment.id, node.kind, node.sort_keys)
         kids = node.children
         if not kids:
             return node

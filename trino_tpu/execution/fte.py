@@ -88,6 +88,7 @@ def run_fte_query(runner, subplan: SubPlan,
     fragments = subplan.all_fragments()  # children first = topological
 
     task_counts, consumer_tasks = runner.stage_task_counts(fragments)
+    output_kinds = {f.id: f.output_kind for f in fragments}
 
     spools: dict[int, list[SpoolBuffer]] = {}
     for f in fragments:
@@ -95,10 +96,16 @@ def run_fte_query(runner, subplan: SubPlan,
         nparts = consumer_tasks.get(f.id, 1)
 
         def run_attempt(task_index: int) -> SpoolBuffer:
-            clients = {
-                src: SpooledExchangeClient(spools[src], task_index)
-                for src in f.source_fragments
-            }
+            clients = {}
+            for src in f.source_fragments:
+                if output_kinds[src] == "MERGE":
+                    clients[src] = [
+                        SpooledExchangeClient([s], task_index)
+                        for s in spools[src]
+                    ]
+                else:
+                    clients[src] = SpooledExchangeClient(
+                        spools[src], task_index)
             planner = LocalPlanner(
                 runner.catalog,
                 splits_per_node=session.splits_per_node,

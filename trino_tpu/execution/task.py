@@ -97,6 +97,138 @@ class RemoteExchangeSourceOperator(Operator):
         return self._closed or self.client.is_finished()
 
 
+class MergeSourceOperator(Operator):
+    """Order-preserving gather of pre-sorted per-producer streams (the
+    MergeOperator.java:46 consumer of a MERGE exchange).
+
+    Small results (client-facing ORDER BY outputs) k-way heap-merge the
+    producer streams row-wise, reproducing the global order without a
+    re-sort; beyond ``MERGE_ROW_LIMIT`` rows the operator falls back to the
+    vectorized sort kernel over the concatenated streams (same result,
+    O(n log n) on device instead of Python-per-row)."""
+
+    blocking = True  # executor flips off: parks instead of pinning a worker
+    MERGE_ROW_LIMIT = 100_000
+
+    def __init__(self, producer_clients, sort_keys, names, types):
+        self.clients = list(producer_clients)
+        self.sort_keys = list(sort_keys)
+        self.names = list(names)
+        self.types = list(types)
+        self.input_done = True
+        self._streams: list[list] = [[] for _ in self.clients]
+        self._emitted = False
+
+    def needs_input(self) -> bool:
+        return False
+
+    def _poll_all(self, wait: bool) -> bool:
+        """Accumulate available pages; True when every stream is complete."""
+        deadline = time.monotonic() + 300.0
+        while True:
+            all_done = True
+            progressed = False
+            for i, c in enumerate(self.clients):
+                if c.is_finished():
+                    continue
+                page = c.poll(timeout=0.05 if wait else 0)
+                if page is not None:
+                    self._streams[i].append(maybe_deserialize(page))
+                    progressed = True
+                if not c.is_finished():
+                    all_done = False
+            if all_done or not wait:
+                return all_done
+            if progressed:
+                deadline = time.monotonic() + 300.0  # reset on activity
+            elif time.monotonic() > deadline:
+                raise TimeoutError("merge source stalled >300s")
+
+    def _row_key(self, row):
+        key = []
+        for k in self.sort_keys:
+            v = row[k.channel]
+            null_rank = (0 if k.nulls_first else 1) if v is None else \
+                (1 if k.nulls_first else 0)
+            if v is None:
+                key.append((null_rank, 0, _MIN_TOKEN))
+                continue
+            nan = isinstance(v, float) and v != v
+            nan_rank = (1 if k.ascending else 0) if nan else (
+                0 if k.ascending else 1)
+            key.append((null_rank, nan_rank,
+                        _Reversed(v) if not k.ascending and not nan else
+                        (_MIN_TOKEN if nan else v)))
+        return tuple(key)
+
+    def _merge(self) -> Optional[ColumnBatch]:
+        batches = [b for s in self._streams for b in s]
+        if not batches:
+            return None
+        total = sum(b.num_rows for b in batches)
+        if total > self.MERGE_ROW_LIMIT:
+            # vectorized fallback: one kernel re-sort of the gathered runs
+            from ..exec import kernels as K
+            from ..exec.operators import _sort_key_tuples
+
+            inp = ColumnBatch.concat(batches)
+            perm = K.sort_perm(_sort_key_tuples(inp, self.sort_keys))
+            return inp.take(perm).rename(self.names)
+        import heapq
+
+        streams = []
+        for s in self._streams:
+            rows: list = []
+            for b in s:
+                rows.extend(b.to_pylist())
+            streams.append(rows)
+        merged = list(heapq.merge(*streams, key=self._row_key))
+        if not merged:
+            return None
+        cols = [Column.from_values(t, [r[i] for r in merged])
+                for i, t in enumerate(self.types)]
+        return ColumnBatch(self.names, cols)
+
+    def get_output(self):
+        if self._emitted or self._closed:
+            return None
+        if not self._poll_all(wait=self.blocking):
+            return None  # parked; the executor reschedules us
+        self._emitted = True
+        return self._merge()
+
+    def is_finished(self) -> bool:
+        return self._emitted or self._closed
+
+
+class _Reversed:
+    """Inverts comparison order for DESC sort keys in the merge heap."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+class _MinToken:
+    __slots__ = ()
+
+    def __lt__(self, other):
+        return not isinstance(other, _MinToken)
+
+    def __eq__(self, other):
+        return isinstance(other, _MinToken)
+
+
+_MIN_TOKEN = _MinToken()
+
+
 class PartitionedOutputSink(Operator):
     """Routes task output into the OutputBuffer: REPARTITION hashes on the
     output keys, BROADCAST replicates, GATHER/OUTPUT lands in partition 0."""

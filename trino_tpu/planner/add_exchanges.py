@@ -13,8 +13,10 @@ partitionings).  Transforms a single-node plan into a distributed one:
 - ``Join(BROADCAST)`` → build side wrapped in ``Exchange(BROADCAST)``
   (BroadcastOutputBuffer path); ``Join(PARTITIONED)`` → both sides hash-
   repartitioned on the join keys (FIXED_HASH_DISTRIBUTION).
-- ``Sort/TopN/Limit/DistinctLimit`` → partial on workers, final above a
-  ``GATHER`` (mirrors Limit/TopN splitting rules).
+- ``Sort`` → per-task sort + order-preserving ``MERGE`` gather (no
+  coordinator re-sort; MergeOperator.java:46); ``TopN`` → partial TopN +
+  ``MERGE`` + final ``Limit``; ``Limit/DistinctLimit`` → partial on
+  workers, final above a ``GATHER``.
 - ``Output``/``TableWriter`` root runs single (coordinator gather).
 
 Leaf fragments stay SOURCE-partitioned (split-driven).
@@ -126,17 +128,22 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         return _replace_source(node, src)
 
     if isinstance(node, Sort):
+        # order-preserving distributed sort: sort per task, MERGE-gather
+        # the pre-sorted streams (reference: MergeOperator.java:46; the
+        # previous shape — gather then re-sort everything — is the
+        # degenerate fallback this replaces)
         src = _visit(node.source, single=False)
-        src = _exchange(src, "GATHER")
-        return Sort(node.output_names, node.output_types, src, node.keys)
+        partial = Sort(node.output_names, node.output_types, src, node.keys)
+        return Exchange(node.output_names, node.output_types, partial,
+                        "MERGE", "REMOTE", (), node.keys)
 
     if isinstance(node, TopN):
         src = _visit(node.source, single=False)
         partial = TopN(node.output_names, node.output_types, src,
                        node.count, node.keys)
-        gathered = _exchange(partial, "GATHER")
-        return TopN(node.output_names, node.output_types, gathered,
-                    node.count, node.keys)
+        merged = Exchange(node.output_names, node.output_types, partial,
+                          "MERGE", "REMOTE", (), node.keys)
+        return Limit(node.output_names, node.output_types, merged, node.count)
 
     if isinstance(node, Limit):
         src = _visit(node.source, single=False)

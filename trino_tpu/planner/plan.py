@@ -313,12 +313,15 @@ class Output(PlanNode):
 class Exchange(PlanNode):
     """Data redistribution boundary.  scope=REMOTE splits fragments
     (AddExchanges.java:138); scope=LOCAL repartitions between in-task
-    pipelines (AddLocalExchanges.java:111)."""
+    pipelines (AddLocalExchanges.java:111).  kind=MERGE gathers pre-sorted
+    per-task streams order-preservingly (``sort_keys``; the
+    MergeOperator.java:46 edge)."""
 
     source: PlanNode = None
-    kind: str = "GATHER"  # GATHER | REPARTITION | BROADCAST
+    kind: str = "GATHER"  # GATHER | REPARTITION | BROADCAST | MERGE
     scope: str = "REMOTE"  # REMOTE | LOCAL
     partition_keys: tuple[int, ...] = ()
+    sort_keys: tuple["SortKey", ...] = ()
 
     @property
     def children(self):
@@ -333,10 +336,12 @@ class Exchange(PlanNode):
 class RemoteSource(PlanNode):
     """Reads a remote fragment's output inside a downstream fragment
     (mirrors sql/planner/plan/RemoteSourceNode.java).  ``fragment_id``
-    names the producing fragment; ``kind`` echoes the exchange type."""
+    names the producing fragment; ``kind`` echoes the exchange type
+    (MERGE carries the producers' sort order in ``sort_keys``)."""
 
     fragment_id: int = -1
     kind: str = "GATHER"
+    sort_keys: tuple["SortKey", ...] = ()
 
     def label(self) -> str:
         return f"RemoteSource[f{self.fragment_id} {self.kind}]"

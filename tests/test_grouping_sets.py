@@ -1,0 +1,145 @@
+"""GROUPING SETS / ROLLUP / CUBE + grouping() + VALUES body (reference:
+sql/planner/plan/GroupIdNode.java, operator/GroupIdOperator.java:32,
+sql/tree/Values.java; behavior per AbstractTestAggregations grouping-set
+cases).  sqlite has no GROUPING SETS, so expectations are equivalence
+against the engine's own UNION ALL expansion plus hand-checked rows."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01),
+                              session=Session(default_catalog="memory"))
+    r.execute("create table gs (k1 varchar, k2 varchar, v bigint)")
+    r.execute("insert into gs values ('a','x',1),('a','y',2),('b','x',3),"
+              "('b','y',4),('a','x',5),('a',null,6)")
+    return r
+
+
+def rows(runner, sql):
+    return runner.execute(sql).rows()
+
+
+def test_rollup(runner):
+    assert rows(runner,
+                "select k1, k2, sum(v) from gs group by rollup(k1, k2) "
+                "order by 1, 2") == [
+        ("a", "x", 6), ("a", "y", 2), ("a", None, 6), ("a", None, 14),
+        ("b", "x", 3), ("b", "y", 4), ("b", None, 7), (None, None, 21)]
+
+
+def test_cube_with_grouping_fn(runner):
+    got = rows(runner,
+               "select k1, k2, sum(v), grouping(k1, k2) from gs "
+               "group by cube(k1, k2) order by 4, 1, 2")
+    assert got == [
+        ("a", "x", 6, 0), ("a", "y", 2, 0), ("a", None, 6, 0),
+        ("b", "x", 3, 0), ("b", "y", 4, 0),
+        ("a", None, 14, 1), ("b", None, 7, 1),
+        (None, "x", 9, 2), (None, "y", 6, 2), (None, None, 6, 2),
+        (None, None, 21, 3)]
+
+
+def test_grouping_sets_explicit(runner):
+    assert rows(runner,
+                "select k1, sum(v) from gs "
+                "group by grouping sets ((k1), ()) order by 1") == [
+        ("a", 14), ("b", 7), (None, 21)]
+
+
+def test_cross_product_element(runner):
+    # GROUP BY k1, ROLLUP(k2) = sets {k1,k2}, {k1}
+    assert rows(runner,
+                "select k1, k2, count(*) from gs group by k1, rollup(k2) "
+                "order by 1, 2") == [
+        ("a", "x", 2), ("a", "y", 1), ("a", None, 1), ("a", None, 4),
+        ("b", "x", 1), ("b", "y", 1), ("b", None, 2)]
+
+
+def test_key_also_aggregate_argument(runner):
+    # v is both a grouping column and an aggregate argument: the GroupId
+    # passthrough copy must keep values un-nulled for the () set
+    assert rows(runner,
+                "select v, sum(v), count(*) from gs "
+                "group by grouping sets ((v), ()) order by 1") == [
+        (1, 1, 1), (2, 2, 1), (3, 3, 1), (4, 4, 1), (5, 5, 1), (6, 6, 1),
+        (None, 21, 6)]
+
+
+def test_union_all_equivalence(runner):
+    gs = rows(runner,
+              "select k1, k2, sum(v), count(*) from gs "
+              "group by grouping sets ((k1, k2), (k1), ()) order by 1, 2, 3")
+    ua = rows(runner,
+              "select k1, k2, sum(v), count(*) from gs group by k1, k2 "
+              "union all "
+              "select k1, null, sum(v), count(*) from gs group by k1 "
+              "union all "
+              "select null, null, sum(v), count(*) from gs order by 1, 2, 3")
+    assert gs == ua
+
+
+def test_having_on_grouping_sets(runner):
+    assert rows(runner,
+                "select k1, sum(v) from gs group by rollup(k1) "
+                "having sum(v) > 10 order by 1") == [
+        ("a", 14), (None, 21)]
+
+
+def test_tpch_rollup_distributed():
+    catalog = default_catalog(scale_factor=0.01)
+    single = StandaloneQueryRunner(catalog)
+    dist = DistributedQueryRunner(catalog, worker_count=3)
+    sql = ("select n_regionkey, count(*) c from tpch.nation "
+           "group by rollup(n_regionkey) order by 1")
+    assert dist.execute(sql).rows() == single.execute(sql).rows()
+
+
+def test_values_body(runner):
+    assert rows(runner,
+                "select a, b from (values (1, 'p'), (2, 'q'), (3, null)) "
+                "as v(a, b) order by a") == [(1, "p"), (2, "q"), (3, None)]
+
+
+def test_values_computed_row(runner):
+    assert rows(runner,
+                "select x + 1 from (values (1 + 1), (10)) as v(x) "
+                "order by 1") == [(3,), (11,)]
+
+
+def test_grouping_fn_requires_group_column(runner):
+    with pytest.raises(Exception):
+        rows(runner, "select grouping(v) from gs group by k1")
+
+
+def test_grouping_fn_in_order_by_only(runner):
+    # grouping() appearing ONLY in ORDER BY must still be rewritten
+    assert rows(runner,
+                "select k1, sum(v) from gs group by rollup(k1) "
+                "order by grouping(k1), k1") == [
+        ("a", 14), ("b", 7), (None, 21)]
+
+
+def test_grouping_fn_plain_group_by(runner):
+    # single grouping set: grouping() is constant 0 (ORDER BY path)
+    assert rows(runner,
+                "select k1, count(*) from gs group by k1 "
+                "order by grouping(k1), k1") == [("a", 4), ("b", 2)]
+
+
+def test_sort_null_nan_payload_ties():
+    # NULL slots backed by NaN garbage (x/0-style) must tie exactly: the
+    # secondary key decides (kernels.sort_perm canonicalization order)
+    import numpy as np
+
+    from trino_tpu.exec import kernels as K
+
+    perm = K.sort_perm([
+        (np.array([np.nan, 7.0]), np.array([False, False]), True, False),
+        (np.array([1, 2]), None, True, False)])
+    assert list(np.asarray(perm)) == [0, 1]

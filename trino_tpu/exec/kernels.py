@@ -395,6 +395,12 @@ def sort_perm(keys: Sequence[tuple]) -> np.ndarray:
                 # bitwise NOT is a bijective order reversal; unary minus maps
                 # INT64_MIN to itself under two's-complement wraparound
                 d = ~d.astype(jnp.int64)
+        if valid is not None:
+            # canonicalize NULL rows' payload FIRST (before NaN ranking):
+            # two NULLs must tie exactly on every derived column, or their
+            # garbage data would decide the less-significant keys
+            v = jnp.asarray(valid)
+            d = jnp.where(v, d, jnp.zeros((), d.dtype))
         nan_rank = None
         if kind == "f":
             # NaN sorts largest (Trino convention) via its own rank column —
@@ -408,7 +414,6 @@ def sort_perm(keys: Sequence[tuple]) -> np.ndarray:
         if nan_rank is not None:
             sort_cols.append(nan_rank)
         if valid is not None:
-            v = jnp.asarray(valid)
             # secondary column is sorted after; null rank must be primary
             null_rank = jnp.where(v, 1, 0) if nulls_first else jnp.where(v, 0, 1)
             sort_cols.append(null_rank)

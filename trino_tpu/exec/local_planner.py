@@ -29,6 +29,7 @@ from .operators import (
     BufferedInputMixin,
     DistinctLimitOperator,
     FilterProjectOperator,
+    GroupIdOperator,
     HashAggregationOperator,
     JoinBridge,
     JoinBuildSink,
@@ -141,6 +142,13 @@ class LocalPlanner:
             chain.append(HashAggregationOperator(
                 node.group_keys, node.aggregates,
                 node.output_names, node.output_types, node.step))
+            return chain
+
+        if isinstance(node, P.GroupId):
+            chain = self._chain(node.source)
+            chain.append(GroupIdOperator(
+                node.key_channels, node.passthrough, node.sets,
+                node.output_names, node.output_types))
             return chain
 
         if isinstance(node, P.Join):

@@ -54,6 +54,7 @@ __all__ = [
     "TopNOperator",
     "WindowOperator",
     "LimitOperator",
+    "GroupIdOperator",
     "ReplicateOperator",
     "DistinctLimitOperator",
     "TableWriterOperator",
@@ -1273,6 +1274,58 @@ class TopNOperator(SortOperator):
         super().finish_input()
         if self._result is not None:
             self._result = self._result.slice(0, self.count)
+
+
+class GroupIdOperator(Operator):
+    """Grouping-sets row expansion (reference: operator/GroupIdOperator.java:32):
+    each input batch yields one output batch per grouping set — grouping
+    columns absent from the set become all-NULL copies, aggregation-argument
+    channels pass through untouched, and a constant $groupid column tags the
+    set.  Masking instead of replicating row-by-row keeps every emitted batch
+    the same fixed shape as its input (XLA-friendly; no dynamic fan-out)."""
+
+    def __init__(self, key_channels, passthrough, sets, output_names,
+                 output_types):
+        self.key_channels = list(key_channels)
+        self.passthrough = list(passthrough)
+        self.sets = [tuple(s) for s in sets]
+        self.output_names = list(output_names)
+        self.gid_type = output_types[-1]
+        self._queue: list[ColumnBatch] = []
+
+    def needs_input(self) -> bool:
+        return not self._queue and super().needs_input()
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        n = batch.num_rows
+        for gid, live_keys in enumerate(self.sets):
+            cols = []
+            for idx, ch in enumerate(self.key_channels):
+                c = batch.columns[ch]
+                if idx in live_keys:
+                    cols.append(c)
+                else:
+                    # all-NULL copy; keep the array backend (host vs device)
+                    if isinstance(c.data, np.ndarray):
+                        invalid = np.zeros(n, dtype=np.bool_)
+                    else:
+                        import jax.numpy as jnp
+
+                        invalid = jnp.zeros(n, dtype=jnp.bool_)
+                    cols.append(Column(c.type, c.data, invalid, c.dictionary))
+            for ch in self.passthrough:
+                cols.append(batch.columns[ch])
+            cols.append(Column(self.gid_type,
+                               np.full(n, gid, dtype=np.int64)))
+            self._queue.append(ColumnBatch(self.output_names, cols, batch.live))
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        if self._queue:
+            return self._queue.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return self.input_done and not self._queue
 
 
 class ReplicateOperator(Operator):

@@ -766,7 +766,21 @@ def _round_handler(out_type, args):
     return Lowered(out_type, None, fn)
 
 
+def _grouping_mask_handler(out_type, args):
+    """grouping() lowering: constant-table gather by the $groupid channel
+    (args = [groupid column, one mask literal per grouping set])."""
+    gid = args[0]
+    masks = np.asarray([_literal_int(a) for a in args[1:]], dtype=np.int64)
+
+    def fn(cols: Cols):
+        v, vv = gid.fn(cols)
+        return jnp.asarray(masks)[v], vv
+
+    return Lowered(out_type, None, fn)
+
+
 HANDLERS: dict[str, Callable] = {
+    "$grouping_mask": _grouping_mask_handler,
     "add": _arith_handler("add"),
     "subtract": _arith_handler("subtract"),
     "multiply": _arith_handler("multiply"),

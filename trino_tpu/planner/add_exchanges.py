@@ -33,6 +33,7 @@ from .plan import (
     DistinctLimit,
     Exchange,
     Filter,
+    GroupId,
     Join,
     Limit,
     Output,
@@ -163,7 +164,7 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         src = _visit(node.source, single=True)
         return _replace_source(node, src)
 
-    if isinstance(node, (Filter, Project, Replicate)):
+    if isinstance(node, (Filter, Project, Replicate, GroupId)):
         src = _visit(node.source, single=single)
         return _replace_source(node, src)
 

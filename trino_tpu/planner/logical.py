@@ -38,6 +38,7 @@ from .plan import (
     AggCall,
     Aggregate,
     Filter,
+    GroupId,
     Join,
     Limit,
     Output,
@@ -271,7 +272,50 @@ class LogicalPlanner:
             rel = self.plan_setop(body, outer, ctes)
             return rel, [InputRef(t, i)
                          for i, t in enumerate(rel.node.output_types)]
+        if isinstance(body, ast.ValuesBody):
+            rel = self.plan_values(body, outer, ctes)
+            return rel, [InputRef(t, i)
+                         for i, t in enumerate(rel.node.output_types)]
         raise AnalysisError(f"unsupported query body: {type(body).__name__}")
+
+    def plan_values(self, body: ast.ValuesBody, outer, ctes) -> RelationPlan:
+        """VALUES rows (reference: sql/tree/Values.java -> ValuesNode).
+        Literal rows build a Values node directly; rows with computed
+        expressions desugar to a UNION ALL of FROM-less selects."""
+        width = len(body.rows[0])
+        for row in body.rows:
+            if len(row) != width:
+                raise AnalysisError("VALUES rows have different column counts")
+        dummy = RelationPlan(
+            Values(("_row",), (BIGINT,), rows=((0,),)), [None])
+        tr = Translator(dummy.scope(outer))
+        from ..spi.types import common_super_type
+
+        rows_ir = [[tr.translate(e) for e in row] for row in body.rows]
+        types: list[Type] = list(e.type for e in rows_ir[0])
+        for r in rows_ir[1:]:
+            for i in range(width):
+                c = common_super_type(types[i], r[i].type)
+                if c is None:
+                    raise AnalysisError(
+                        f"VALUES column {i + 1} type mismatch: "
+                        f"{types[i]} vs {r[i].type}")
+                types[i] = c
+        if any(t == UNKNOWN for t in types):
+            raise AnalysisError("VALUES column is entirely NULL; add a CAST")
+        names = tuple(f"_col{i}" for i in range(width))
+        if all(isinstance(e, Literal) for r in rows_ir for e in r):
+            rows = tuple(tuple(e.value for e in r) for r in rows_ir)
+            return RelationPlan(Values(names, tuple(types), rows),
+                                [None] * width)
+        # computed expressions: UNION ALL of single-row selects
+        def spec_of(row) -> ast.QueryBody:
+            return ast.QuerySpec(tuple(ast.SelectItem(e) for e in row))
+
+        acc: ast.QueryBody = spec_of(body.rows[0])
+        for row in body.rows[1:]:
+            acc = ast.SetOp("UNION", False, acc, spec_of(row))
+        return self.plan_body(acc, outer, ctes)[0]
 
     def plan_setop(self, op: ast.SetOp, outer, ctes) -> RelationPlan:
         """UNION/INTERSECT/EXCEPT (reference: sql/planner/plan/
@@ -411,22 +455,24 @@ class LogicalPlanner:
 
         has_aggs = bool(collector.calls)
         covered_check = None
+        gs_ctx = None  # (group_irs, set_list, gid channel or None)
         if has_group or has_aggs:
-            # GROUP BY <ordinal> resolves to the select item's expression
-            # (SqlBase.g4 groupBy -> expression; ordinal handling mirrors
-            # StatementAnalyzer.analyzeGroupBy)
-            group_asts = []
-            for g in spec.group_by:
-                if isinstance(g, ast.IntLiteral):
-                    if not 1 <= g.value <= len(select_items):
-                        raise AnalysisError(
-                            f"GROUP BY position {g.value} is not in select list")
-                    group_asts.append(select_items[g.value - 1].expr)
-                else:
-                    group_asts.append(g)
-            group_irs = [Translator(rel.scope(outer)).translate(g)
-                         for g in group_asts]
-            rel, rewrite = self._plan_aggregation(rel, group_irs, collector, outer)
+            group_irs, set_list = self._expand_grouping(
+                spec.group_by, select_items, rel, outer)
+            grouping_calls = [
+                x for e in (select_irs + ([having_ir] if having_ir is not None else []))
+                for x in walk(e)
+                if isinstance(x, Call) and x.name == "$grouping"]
+            if len(set_list) > 1 or grouping_calls:
+                rel, rewrite, gid_ch = self._plan_grouping_sets(
+                    rel, group_irs, set_list, collector, outer)
+                rewrite.update(self._grouping_mask_rewrites(
+                    grouping_calls, group_irs, set_list, gid_ch))
+                gs_ctx = (group_irs, set_list, gid_ch)
+            else:
+                rel, rewrite = self._plan_aggregation(
+                    rel, group_irs, collector, outer)
+                gs_ctx = (group_irs, set_list, None)
 
             # validate BEFORE rewriting: every select subtree must be a
             # group-by expression, an aggregate placeholder, or composed of
@@ -506,11 +552,30 @@ class LogicalPlanner:
                 raise AnalysisError(
                     f"ORDER BY aggregate not in select list: {e}")
             if has_group or has_aggs:
+                # ORDER BY may carry grouping() calls not present in the
+                # select list: give them the same $grouping_mask rewrite
+                extra: dict = {}
+                gcalls = [x for x in walk(ir)
+                          if isinstance(x, Call) and x.name == "$grouping"]
+                if gcalls:
+                    g_irs, s_list, gid = gs_ctx
+                    if gid is None:
+                        # single grouping set: grouping() is constant 0
+                        for x in gcalls:
+                            for a in x.args:
+                                if a not in g_irs:
+                                    raise AnalysisError(
+                                        "grouping() arguments must appear "
+                                        "in GROUP BY")
+                            extra[x] = Literal(BIGINT, 0)
+                    else:
+                        extra = self._grouping_mask_rewrites(
+                            gcalls, g_irs, s_list, gid)
                 if covered_check is not None and not covered_check(ir):
                     raise AnalysisError(
                         f"'{e}' must be an aggregate expression or appear "
                         "in GROUP BY clause")
-                ir = rewrite_expr(ir, rewrite)
+                ir = rewrite_expr(ir, {**rewrite, **extra})
             if win_rewrite:
                 ir = rewrite_expr(ir, win_rewrite)
             return ir
@@ -575,6 +640,158 @@ class LogicalPlanner:
             placeholder = Call(out_t, "$aggref", (Literal(BIGINT, j),))
             rewrite[placeholder] = InputRef(out_t, len(key_channels) + j)
         return out, rewrite
+
+    # ------------------------------------------------------- grouping sets
+    def _expand_grouping(self, group_by, select_items, rel, outer):
+        """Expand GROUP BY elements (exprs, ROLLUP, CUBE, GROUPING SETS) into
+        (group_irs, sets): the ordered distinct grouping columns as IR, and
+        one tuple of column indices per grouping set.  Multiple elements
+        combine by cross product (SQL:2016 7.9; reference:
+        StatementAnalyzer.analyzeGroupBy computing the set product)."""
+
+        def resolve(g: ast.Expr) -> ast.Expr:
+            # GROUP BY <ordinal> resolves to the select item's expression
+            if isinstance(g, ast.IntLiteral):
+                if not 1 <= g.value <= len(select_items):
+                    raise AnalysisError(
+                        f"GROUP BY position {g.value} is not in select list")
+                return select_items[g.value - 1].expr
+            return g
+
+        element_sets: list[list[tuple[ast.Expr, ...]]] = []
+        for el in group_by:
+            if isinstance(el, ast.Rollup):
+                exprs = [resolve(e) for e in el.exprs]
+                element_sets.append(
+                    [tuple(exprs[:k]) for k in range(len(exprs), -1, -1)])
+            elif isinstance(el, ast.Cube):
+                exprs = [resolve(e) for e in el.exprs]
+                subsets = [
+                    tuple(e for i, e in enumerate(exprs) if mask & (1 << i))
+                    for mask in range(1 << len(exprs))]
+                subsets.sort(key=len, reverse=True)
+                element_sets.append(subsets)
+            elif isinstance(el, ast.GroupingSets):
+                element_sets.append(
+                    [tuple(resolve(e) for e in s) for s in el.sets])
+            else:
+                element_sets.append([(resolve(el),)])
+        combined: list[tuple[ast.Expr, ...]] = [()]
+        for sets in element_sets:
+            combined = [c + s for c in combined for s in sets]
+
+        tr = Translator(rel.scope(outer))
+        group_irs: list[RowExpression] = []
+        index: dict[RowExpression, int] = {}
+        set_list: list[tuple[int, ...]] = []
+        for s in combined:
+            idxs: list[int] = []
+            for e in s:
+                ir = tr.translate(e)
+                if ir not in index:
+                    index[ir] = len(group_irs)
+                    group_irs.append(ir)
+                if index[ir] not in idxs:
+                    idxs.append(index[ir])
+            set_list.append(tuple(idxs))
+        return group_irs, set_list
+
+    def _plan_grouping_sets(self, rel, group_irs, set_list, collector, outer):
+        """GroupId + Aggregate keyed on (all grouping columns, $groupid)
+        (reference: sql/planner/QueryPlanner.planGroupingSets building
+        GroupIdNode).  Returns (relation, rewrite, groupid channel in the
+        aggregation output)."""
+        pre_exprs: list[RowExpression] = []
+        pre_names: list[str] = []
+
+        def channel_of(e: RowExpression) -> int:
+            if isinstance(e, InputRef):
+                return e.index
+            for j, pe in enumerate(pre_exprs):
+                if pe == e:
+                    return rel.width + j
+            pre_exprs.append(e)
+            pre_names.append(f"_expr{len(pre_exprs)}")
+            return rel.width + len(pre_exprs) - 1
+
+        key_channels = [channel_of(g) for g in group_irs]
+        agg_specs = []
+        for fn, arg, distinct, out_t in collector.calls:
+            ch = channel_of(arg) if arg is not None else -1
+            agg_specs.append((fn, ch, distinct, out_t))
+        src = rel
+        if pre_exprs:
+            src = rel.append(pre_exprs, pre_names)
+
+        # aggregation arguments pass through un-nulled copies: a grouping
+        # column that is also an aggregate argument must keep its values
+        pass_chs: list[int] = []
+        for _, ch, _, _ in agg_specs:
+            if ch >= 0 and ch not in pass_chs:
+                pass_chs.append(ch)
+        nk = len(key_channels)
+        g_names = tuple(
+            [src.node.output_names[c] for c in key_channels]
+            + [src.node.output_names[c] for c in pass_chs]
+            + ["$groupid"])
+        g_types = tuple(
+            [src.node.output_types[c] for c in key_channels]
+            + [src.node.output_types[c] for c in pass_chs]
+            + [BIGINT])
+        gid_node = GroupId(g_names, g_types, src.node,
+                           tuple(key_channels), tuple(pass_chs),
+                           tuple(set_list))
+
+        agg_calls = []
+        for fn, ch, distinct, out_t in agg_specs:
+            new_ch = nk + pass_chs.index(ch) if ch >= 0 else -1
+            agg_calls.append(AggCall(fn, new_ch, out_t, distinct))
+        gkeys = tuple(range(nk)) + (nk + len(pass_chs),)
+        a_names = tuple(
+            list(g_names[:nk]) + ["$groupid"]
+            + [f"_agg{j}" for j in range(len(agg_calls))])
+        a_types = tuple(
+            list(g_types[:nk]) + [BIGINT] + [a.type for a in agg_calls])
+        agg = Aggregate(a_names, a_types, gid_node, gkeys, tuple(agg_calls))
+        out = RelationPlan(agg, [None] * len(a_names))
+        rewrite: dict[RowExpression, RowExpression] = {}
+        for i, g in enumerate(group_irs):
+            rewrite[g] = InputRef(g.type, i)
+        for j, (fn, arg, distinct, out_t) in enumerate(collector.calls):
+            placeholder = Call(out_t, "$aggref", (Literal(BIGINT, j),))
+            rewrite[placeholder] = InputRef(out_t, nk + 1 + j)
+        return out, rewrite, nk
+
+    def _grouping_mask_rewrites(self, grouping_calls, group_irs, set_list,
+                                gid_ch):
+        """Map each $grouping(cols…) marker onto a $grouping_mask(gid,
+        mask-per-set…) gather (reference: planner/GroupingOperationRewriter:
+        grouping() = bitmask of arguments absent from the row's set, first
+        argument = most significant bit)."""
+        out: dict[RowExpression, RowExpression] = {}
+        for x in grouping_calls:
+            if x in out:
+                continue
+            idxs = []
+            for a in x.args:
+                try:
+                    idxs.append(group_irs.index(a))
+                except ValueError:
+                    raise AnalysisError(
+                        "grouping() arguments must appear in GROUP BY")
+            n = len(idxs)
+            masks = []
+            for s in set_list:
+                m = 0
+                for pos, gi in enumerate(idxs):
+                    if gi not in s:
+                        m |= 1 << (n - 1 - pos)
+                masks.append(m)
+            out[x] = Call(
+                BIGINT, "$grouping_mask",
+                tuple([InputRef(BIGINT, gid_ch)]
+                      + [Literal(BIGINT, m) for m in masks]))
+        return out
 
     # -------------------------------------------------------------- windows
     def _plan_windows(self, rel: RelationPlan, wcollector: WindowCollector,
@@ -666,7 +883,14 @@ class LogicalPlanner:
             return RelationPlan(node, [qual] * len(cols))
         if isinstance(r, ast.SubqueryRelation):
             rel = self.plan_query(r.query, outer, ctes)
-            return RelationPlan(rel.node, [r.alias] * rel.width)
+            node = rel.node
+            if r.column_names is not None:
+                if len(r.column_names) != rel.width:
+                    raise AnalysisError(
+                        f"column alias list has {len(r.column_names)} names "
+                        f"but relation has {rel.width} columns")
+                node = replace(node, output_names=tuple(r.column_names))
+            return RelationPlan(node, [r.alias] * rel.width)
         if isinstance(r, ast.Join):
             return self.plan_join(r, outer, ctes)
         raise AnalysisError(f"unsupported relation: {type(r).__name__}")

@@ -28,6 +28,7 @@ from .plan import (
     DistinctLimit,
     Exchange,
     Filter,
+    GroupId,
     Join,
     Limit,
     Output,
@@ -178,6 +179,8 @@ def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
         return float(len(node.rows))
     if isinstance(node, Union):
         return sum(estimate_rows(s, catalog) for s in node.sources)
+    if isinstance(node, GroupId):
+        return estimate_rows(node.source, catalog) * max(1, len(node.sets))
     for c in node.children:
         return estimate_rows(c, catalog)
     return 1000.0
@@ -277,6 +280,13 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
         if isinstance(node, Replicate):
             kwargs["count_channel"] = m[node.count_channel]
         return replace(node, **kwargs), m
+
+    if isinstance(node, GroupId):
+        child, m = _rewrite(node.source, catalog)
+        out = replace(node, source=child,
+                      key_channels=tuple(m[c] for c in node.key_channels),
+                      passthrough=tuple(m[c] for c in node.passthrough))
+        return out, _identity(node)
 
     if isinstance(node, Window):
         child, m = _rewrite(node.source, catalog)
@@ -711,6 +721,16 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
                       output_names=child.output_names,
                       output_types=child.output_types)
         return out, cm
+
+    if isinstance(node, GroupId):
+        # every output is load-bearing for the Aggregate above (keys + gid
+        # are its grouping keys; passthroughs its arguments): prune below only
+        child_needed = set(node.key_channels) | set(node.passthrough)
+        child, cm = _prune(node.source, child_needed)
+        out = replace(node, source=child,
+                      key_channels=tuple(cm[c] for c in node.key_channels),
+                      passthrough=tuple(cm[c] for c in node.passthrough))
+        return out, list(range(len(node.output_types)))
 
     if isinstance(node, Window):
         sw = len(node.source.output_types)

@@ -20,6 +20,7 @@ from ..sql.ir import RowExpression
 
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
+    "GroupId",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
     "Window", "WindowFunc", "Union", "Replicate", "plan_text",
@@ -102,6 +103,30 @@ class Aggregate(PlanNode):
         aggs = ", ".join(f"{a.fn}({'*' if a.arg < 0 else '#%d' % a.arg}{' distinct' if a.distinct else ''})"
                          for a in self.aggregates)
         return f"Aggregate[{self.step} keys={list(self.group_keys)} {aggs}]"
+
+
+@dataclass(frozen=True)
+class GroupId(PlanNode):
+    """Grouping-sets row expansion (reference: sql/planner/plan/
+    GroupIdNode.java, operator/GroupIdOperator.java:32): replicates every
+    input row once per grouping set, nulling grouping columns absent from
+    the set and appending a group-id column.  Output channels =
+    [one copy per key_channels entry] ++ [passthrough channels (aggregation
+    arguments, never nulled)] ++ [$groupid BIGINT].  ``sets`` holds, per
+    grouping set, the indices into ``key_channels`` that remain live."""
+
+    source: PlanNode = None
+    key_channels: tuple[int, ...] = ()
+    passthrough: tuple[int, ...] = ()
+    sets: tuple[tuple[int, ...], ...] = ()
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return (f"GroupId[keys={list(self.key_channels)} "
+                f"sets={[list(s) for s in self.sets]}]")
 
 
 @dataclass(frozen=True)

@@ -510,6 +510,17 @@ class Translator:
             return Call(out_t, "$aggref", (Literal(BIGINT, idx),))
         if name == "coalesce":
             return self._t_coalesce(e)
+        if name == "grouping":
+            # grouping(a, b): bitmask of arguments NOT present in the row's
+            # grouping set (reference: sql/analyzer/AggregationAnalyzer +
+            # planner GroupingOperationRewriter).  The planner rewrites the
+            # $grouping marker onto the GroupId channel.
+            if self.aggregates is None:
+                raise AnalysisError("grouping() not allowed here")
+            if not e.args:
+                raise AnalysisError("grouping() requires arguments")
+            return Call(BIGINT, "$grouping",
+                        tuple(self.translate(a) for a in e.args))
         return self._t_scalar_call(e)
 
     def _t_agg_special(self, e: ast.FunctionCall, name: str) -> RowExpression:

@@ -230,6 +230,7 @@ class Table(Relation):
 class SubqueryRelation(Relation):
     query: "Query"
     alias: Optional[str] = None
+    column_names: Optional[tuple[str, ...]] = None  # AS v(a, b, c)
 
 
 @dataclass(frozen=True)
@@ -264,8 +265,31 @@ class QuerySpec:
     distinct: bool = False
     from_: Optional[Relation] = None
     where: Optional[Expr] = None
-    group_by: tuple[Expr, ...] = ()
+    # elements are plain Exprs or GroupingSets/Rollup/Cube grouping elements
+    group_by: tuple = ()
     having: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class GroupingSets:
+    """GROUP BY GROUPING SETS ((a, b), (a), ()) element (reference:
+    sql/tree/GroupingSets.java; SqlBase.g4 groupingElement)."""
+
+    sets: tuple[tuple[Expr, ...], ...]
+
+
+@dataclass(frozen=True)
+class Rollup:
+    """GROUP BY ROLLUP (a, b) — prefix hierarchy of grouping sets."""
+
+    exprs: tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Cube:
+    """GROUP BY CUBE (a, b) — all subsets as grouping sets."""
+
+    exprs: tuple[Expr, ...]
 
 
 @dataclass(frozen=True)
@@ -288,7 +312,15 @@ class WithQuery:
 
 # a query body is a SELECT spec, a set operation over bodies, or a nested
 # parenthesized query (which may carry its own ORDER BY / LIMIT)
-QueryBody = Union["QuerySpec", "SetOp", "Query"]
+@dataclass(frozen=True)
+class ValuesBody:
+    """VALUES (a, b), (c, d) as a query body (reference: sql/tree/Values.java;
+    SqlBase.g4 queryPrimary -> VALUES expression*)."""
+
+    rows: tuple[tuple[Expr, ...], ...]
+
+
+QueryBody = Union["QuerySpec", "SetOp", "Query", "ValuesBody"]
 
 
 @dataclass(frozen=True)

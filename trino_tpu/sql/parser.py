@@ -305,7 +305,21 @@ class _Parser:
             q = self.parse_query()
             self.expect_op(")")
             return q
+        if self.accept_kw("values"):
+            rows = [self._parse_values_row()]
+            while self.accept_op(","):
+                rows.append(self._parse_values_row())
+            return ast.ValuesBody(tuple(rows))
         return self.parse_query_spec()
+
+    def _parse_values_row(self) -> tuple:
+        if self.accept_op("("):
+            es = [self.parse_expr()]
+            while self.accept_op(","):
+                es.append(self.parse_expr())
+            self.expect_op(")")
+            return tuple(es)
+        return (self.parse_expr(),)
 
     def parse_query_spec(self) -> ast.QuerySpec:
         self.expect_kw("select")
@@ -324,15 +338,55 @@ class _Parser:
                 right = self.parse_relation()
                 from_ = ast.Join("CROSS", from_, right, None)
         where = self.parse_expr() if self.accept_kw("where") else None
-        group_by: tuple[ast.Expr, ...] = ()
+        group_by: tuple = ()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            gb = [self.parse_expr()]
+            gb = [self.parse_grouping_element()]
             while self.accept_op(","):
-                gb.append(self.parse_expr())
+                gb.append(self.parse_grouping_element())
             group_by = tuple(gb)
         having = self.parse_expr() if self.accept_kw("having") else None
         return ast.QuerySpec(tuple(select), distinct, from_, where, group_by, having)
+
+    def parse_grouping_element(self):
+        """One GROUP BY element: expr | ROLLUP(..) | CUBE(..) |
+        GROUPING SETS ((..), ..).  ROLLUP/CUBE/GROUPING stay soft keywords:
+        they only take this path when the following tokens disambiguate
+        (SqlBase.g4 groupingElement)."""
+        t = self.cur
+        if (t.kind == "ident" and t.text.lower() in ("rollup", "cube")
+                and self.tokens[self.i + 1].text == "("):
+            name = self.advance().text.lower()
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            return (ast.Rollup(tuple(exprs)) if name == "rollup"
+                    else ast.Cube(tuple(exprs)))
+        if (t.kind == "ident" and t.text.lower() == "grouping"
+                and self.tokens[self.i + 1].kind == "ident"
+                and self.tokens[self.i + 1].text.lower() == "sets"):
+            self.advance()
+            self.advance()
+            self.expect_op("(")
+            sets = [self._parse_grouping_set()]
+            while self.accept_op(","):
+                sets.append(self._parse_grouping_set())
+            self.expect_op(")")
+            return ast.GroupingSets(tuple(sets))
+        return self.parse_expr()
+
+    def _parse_grouping_set(self) -> tuple:
+        if self.accept_op("("):
+            if self.accept_op(")"):
+                return ()
+            es = [self.parse_expr()]
+            while self.accept_op(","):
+                es.append(self.parse_expr())
+            self.expect_op(")")
+            return tuple(es)
+        return (self.parse_expr(),)
 
     def parse_select_item(self) -> ast.SelectItem:
         if self.accept_op("*"):
@@ -392,7 +446,14 @@ class _Parser:
             q = self.parse_query()
             self.expect_op(")")
             alias = self._maybe_alias()
-            return ast.SubqueryRelation(q, alias)
+            colnames = None
+            if alias is not None and self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+                colnames = tuple(cols)
+            return ast.SubqueryRelation(q, alias, colnames)
         name = self.qualified_name()
         alias = self._maybe_alias()
         return ast.Table(name, alias)

@@ -1,0 +1,148 @@
+"""Control plane: state machines, dispatcher + resource groups, discovery +
+heartbeat failure detection (reference: execution/StateMachine.java:43,
+QueryState.java:26, dispatcher/DispatchManager.java:72,
+resourcegroups/InternalResourceGroup.java:75,
+failuredetector/HeartbeatFailureDetector.java:76)."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.control import (
+    DispatchManager,
+    HeartbeatFailureDetector,
+    NodeManager,
+    QueryStateMachine,
+    ResourceGroup,
+    StateMachine,
+)
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+
+
+def test_state_machine_listeners_and_terminal():
+    fsm = StateMachine("t", "A", {"DONE"})
+    seen = []
+    fsm.add_listener(seen.append)
+    fsm.set("B")
+    fsm.set("DONE")
+    assert not fsm.set("B")  # terminal absorbs
+    assert seen == ["A", "B", "DONE"]
+    assert fsm.is_terminal()
+
+
+def test_query_fsm_lifecycle():
+    fsm = QueryStateMachine("q1")
+    for s in ("WAITING_FOR_RESOURCES", "DISPATCHING", "PLANNING",
+              "STARTING", "RUNNING", "FINISHING"):
+        assert fsm.set(s)
+    fsm.finish()
+    assert fsm.state == "FINISHED"
+    assert fsm.end_time is not None
+
+
+def test_resource_group_concurrency_queueing():
+    g = ResourceGroup("root", hard_concurrency_limit=1, max_queued=10)
+    g.acquire()
+    order = []
+
+    def queued_worker(i):
+        g.acquire(timeout=10)
+        order.append(i)
+        g.release()
+
+    ts = [threading.Thread(target=queued_worker, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+        time.sleep(0.05)  # deterministic FIFO enqueue order
+    assert g.queued == 3 and g.running == 1
+    g.release()
+    for t in ts:
+        t.join(timeout=10)
+    assert order == [0, 1, 2]  # FIFO admission
+    assert g.running == 0
+
+
+def test_resource_group_queue_full():
+    g = ResourceGroup("root", hard_concurrency_limit=1, max_queued=0)
+    g.acquire()
+    with pytest.raises(RuntimeError):
+        g.acquire()
+    g.release()
+
+
+def test_hierarchical_limits():
+    root = ResourceGroup("root", hard_concurrency_limit=1)
+    a = root.subgroup("a", hard_concurrency_limit=5)
+    a.acquire()
+    assert root.running == 1 and a.running == 1
+    # parent limit binds even though the child has slots
+    done = []
+    t = threading.Thread(target=lambda: (a.acquire(timeout=10),
+                                         done.append(1), a.release()))
+    t.start()
+    time.sleep(0.1)
+    assert not done
+    a.release()
+    t.join(timeout=10)
+    assert done
+
+
+def test_dispatcher_tracks_queries():
+    d = DispatchManager()
+    out = d.submit("select 1", None, lambda fsm: 42)
+    assert out == 42
+    infos = d.queries()
+    assert len(infos) == 1 and infos[0].state == "FINISHED"
+    with pytest.raises(ValueError):
+        d.submit("select boom", None,
+                 lambda fsm: (_ for _ in ()).throw(ValueError("x")))
+    assert d.queries()[-1].state == "FAILED"
+
+
+def test_node_manager_heartbeats_and_drain():
+    nm = NodeManager(heartbeat_timeout=0.2)
+    nm.announce("w0")
+    nm.announce("w1")
+    assert nm.active_workers() == ["w0", "w1"]
+    nm.drain("w1")
+    assert nm.active_workers() == ["w0"]
+    time.sleep(0.3)
+    assert nm.active_workers() == []  # heartbeats expired
+    nm.announce("w0")
+    assert nm.active_workers() == ["w0"]
+
+
+def test_failure_detector_marks_and_recovers():
+    nm = NodeManager(heartbeat_timeout=60)
+    nm.announce("w0")
+    alive = {"up": True}
+    fd = HeartbeatFailureDetector(nm, interval=0.05)
+    fd.monitor("w0", lambda: alive["up"])
+    fd.ping_once()
+    assert fd.failed_nodes() == set()
+    alive["up"] = False
+    fd.ping_once()
+    assert fd.failed_nodes() == {"w0"}
+    alive["up"] = True
+    fd.ping_once()
+    assert fd.failed_nodes() == set()
+
+
+def test_runner_routes_through_dispatcher_and_sheds_dead_workers():
+    runner = DistributedQueryRunner(default_catalog(scale_factor=0.01),
+                                    worker_count=3)
+    sql = "select n_regionkey, count(*) from tpch.nation group by n_regionkey order by 1"
+    expect = runner.execute(sql).rows()
+    assert runner.dispatcher.queries()[-1].state == "FINISHED"
+    assert runner.active_worker_count == 3
+    # kill one worker's heartbeat: placement shrinks, results unchanged
+    runner.failure_detector.monitor("worker-2", lambda: False)
+    runner.nodes.remove("worker-2")
+    assert runner.active_worker_count == 2
+    assert runner.execute(sql).rows() == expect
+    # graceful drain of another
+    runner.nodes.drain("worker-1")
+    assert runner.active_worker_count == 1
+    assert runner.execute(sql).rows() == expect

@@ -180,8 +180,8 @@ class FileConnector(Connector):
         return [Split("file", table, os.path.join(self._dir(table), p))
                 for p in meta["pages"]]
 
-    def create_page_source(self, split: Split,
-                           columns: Sequence[str]) -> ConnectorPageSource:
+    def create_page_source(self, split: Split, columns: Sequence[str],
+                           constraint=None) -> ConnectorPageSource:
         return _FilePageSource(split.info, columns)
 
     # ---- write ----------------------------------------------------------

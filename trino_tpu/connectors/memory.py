@@ -43,6 +43,49 @@ class _ListPageSource(ConnectorPageSource):
         return self._i >= len(self._batches)
 
 
+def _batch_overlaps(b: ColumnBatch, constraint) -> bool:
+    """Min/max zone-map check: can any row of the host batch satisfy the
+    TupleDomain?  Device-pinned batches (live mask) always pass — pulling
+    them down for stats would defeat the pinning.  Stats are computed once
+    per batch and memoized on the batch object (the reference keeps
+    per-page min/max in connector metadata, e.g. ORC stripe stats)."""
+    if b.live is not None:
+        return True
+    stats = getattr(b, "_domain_stats", None)
+    if stats is None:
+        stats = {}
+        b._domain_stats = stats
+    missing = [n for n in constraint.domains
+               if n not in stats and n in b.names]
+    if missing:
+        for name in missing:
+            c = b.columns[b.names.index(name)]
+            data = np.asarray(c.data)
+            valid = None if c.valid is None else np.asarray(c.valid)
+            has_null = bool((~valid).any()) if valid is not None else False
+            if c.dictionary is not None:
+                present = data if valid is None else data[valid]
+                if present.size:
+                    vals = c.dictionary[np.unique(present)]
+                    stats[name] = (str(vals[0]), str(vals[-1]), has_null)
+                else:
+                    stats[name] = (None, None, has_null)
+            elif np.issubdtype(data.dtype, np.number) or data.dtype == bool:
+                present = data if valid is None else data[valid]
+                if present.size:
+                    mn, mx = present.min(), present.max()
+                    if isinstance(mn, np.floating) and (
+                            np.isnan(mn) or np.isnan(mx)):
+                        continue  # NaNs poison comparisons: no stats
+                    stats[name] = (mn.item(), mx.item(), has_null)
+                else:
+                    stats[name] = (None, None, has_null)
+    mins = {k: v[0] for k, v in stats.items()}
+    maxs = {k: v[1] for k, v in stats.items()}
+    nulls = {k: v[2] for k, v in stats.items()}
+    return constraint.overlaps_stats(mins, maxs, nulls)
+
+
 class _MemoryPageSink(ConnectorPageSink):
     def __init__(self, connector: "MemoryConnector", table: str):
         self._connector = connector
@@ -67,6 +110,8 @@ class MemoryConnector(Connector):
         # live-row counts of device-pinned tables (padding rows excluded;
         # computed once at pin time to avoid per-query device syncs)
         self._pinned_rows: dict[str, int] = {}
+        # observability: batches skipped by TupleDomain min/max pruning
+        self.batches_pruned = 0
 
     def list_tables(self) -> list[str]:
         with self._lock:
@@ -111,10 +156,16 @@ class MemoryConnector(Connector):
             if bounds[i + 1] > bounds[i] or n == 0 and i == 0
         ]
 
-    def create_page_source(self, split: Split, columns: Sequence[str]) -> ConnectorPageSource:
+    def create_page_source(self, split: Split, columns: Sequence[str],
+                           constraint=None) -> ConnectorPageSource:
         lo, hi = split.info
         with self._lock:
             batches = self._data[split.table][lo:hi]
+        if constraint is not None and not constraint.is_all:
+            kept = [b for b in batches
+                    if _batch_overlaps(b, constraint)]
+            self.batches_pruned += len(batches) - len(kept)
+            batches = kept
         return _ListPageSource(batches, columns)
 
     def create_page_sink(self, table: str) -> ConnectorPageSink:

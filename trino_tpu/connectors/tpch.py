@@ -321,7 +321,8 @@ class TpchConnector(Connector):
             if bounds[i + 1] > bounds[i]
         ]
 
-    def create_page_source(self, split: Split, columns: Sequence[str]) -> "_TpchPageSource":
+    def create_page_source(self, split: Split, columns: Sequence[str],
+                           constraint=None) -> "_TpchPageSource":
         return _TpchPageSource(self, split, list(columns))
 
     # ---- dictionaries ---------------------------------------------------

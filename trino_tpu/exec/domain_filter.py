@@ -1,0 +1,64 @@
+"""TupleDomain row masking at the scan boundary.
+
+The enforcement half of predicate pushdown (planner/domains.py): scans
+evaluate the advisory TupleDomain on host numpy columns BEFORE padding and
+device transfer, so provably-dead rows never consume HBM bandwidth.  The
+exact Filter above the scan still runs (enforced=false semantics, matching
+PushPredicateIntoTableScan + the connector returning unenforced domains)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..spi.batch import ColumnBatch
+from ..spi.predicate import TupleDomain, ValueSet
+
+__all__ = ["tuple_domain_mask"]
+
+
+def _valueset_mask(data: np.ndarray, vs: ValueSet) -> np.ndarray:
+    if vs.is_all:
+        return np.ones(len(data), dtype=bool)
+    pts = vs.points()
+    if pts is not None:
+        if not pts:
+            return np.zeros(len(data), dtype=bool)
+        return np.isin(data, np.asarray(pts))
+    m = np.zeros(len(data), dtype=bool)
+    for r in vs.ranges:
+        rm = np.ones(len(data), dtype=bool)
+        if r.low is not None:
+            rm &= (data >= r.low) if r.low_inclusive else (data > r.low)
+        if r.high is not None:
+            rm &= (data <= r.high) if r.high_inclusive else (data < r.high)
+        m |= rm
+    return m
+
+
+def tuple_domain_mask(batch: ColumnBatch, constraint: TupleDomain,
+                      name_to_idx: dict[str, int]) -> Optional[np.ndarray]:
+    """Boolean keep-mask for a host batch under ``constraint`` (None = keep
+    all rows).  Dictionary columns evaluate the domain once per dictionary
+    entry and gather; plain columns evaluate on the storage array."""
+    if constraint.is_none:
+        return np.zeros(batch.num_rows, dtype=bool)
+    mask: Optional[np.ndarray] = None
+    for col, dom in constraint.domains.items():
+        idx = name_to_idx.get(col)
+        if idx is None:
+            continue
+        c = batch.columns[idx]
+        data = np.asarray(c.data)
+        if c.dictionary is not None:
+            tab = np.array(
+                [dom.values.contains_value(str(v)) for v in c.dictionary],
+                dtype=bool)
+            m = tab[data] if len(tab) else np.zeros(len(data), dtype=bool)
+        else:
+            m = _valueset_mask(data, dom.values)
+        if c.valid is not None:
+            m = np.where(np.asarray(c.valid), m, dom.null_allowed)
+        mask = m if mask is None else (mask & m)
+    return mask

@@ -45,6 +45,7 @@ from .operators import (
     SortOperator,
     TableWriterOperator,
     TopNOperator,
+    UnnestOperator,
     UnionSinkOperator,
     UnionSourceOperator,
     ValuesOperator,
@@ -104,7 +105,8 @@ class LocalPlanner:
                 node.table, self.splits_per_node, self.node_count)
             mine = [s for i, s in enumerate(splits)
                     if i % self.task_count == self.task_index]
-            return [ScanOperator(conn, mine, node.columns)]
+            return [ScanOperator(conn, mine, node.columns,
+                                 constraint=node.constraint)]
 
         if isinstance(node, P.RemoteSource):
             from ..execution.collective_exchange import (
@@ -148,6 +150,13 @@ class LocalPlanner:
             chain = self._chain(node.source)
             chain.append(GroupIdOperator(
                 node.key_channels, node.passthrough, node.sets,
+                node.output_names, node.output_types))
+            return chain
+
+        if isinstance(node, P.Unnest):
+            chain = self._chain(node.source)
+            chain.append(UnnestOperator(
+                node.replicate, node.unnest_channels, node.ordinality,
                 node.output_names, node.output_types))
             return chain
 

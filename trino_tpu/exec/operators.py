@@ -56,6 +56,7 @@ __all__ = [
     "LimitOperator",
     "GroupIdOperator",
     "ReplicateOperator",
+    "UnnestOperator",
     "DistinctLimitOperator",
     "TableWriterOperator",
     "OutputCollector",
@@ -101,16 +102,31 @@ class ScanOperator(Operator):
     DynamicFilterService — see exec/dynamic_filter.py)."""
 
     def __init__(self, connector: Connector, splits: Sequence[Split],
-                 columns: Sequence[str], dynamic_filters=None):
+                 columns: Sequence[str], dynamic_filters=None,
+                 constraint=None):
         self.connector = connector
         self.splits = list(splits)
         self.columns = list(columns)
         self.dynamic_filters = list(dynamic_filters or [])
+        # advisory TupleDomain from predicate pushdown (exec/domain_filter.py)
+        self.constraint = constraint if (
+            constraint is not None and not constraint.is_all) else None
+        self._name_to_idx = {n: i for i, n in enumerate(self.columns)}
+        self.rows_pruned_by_domain = 0
         self._source = None
         self.input_done = True
 
     def needs_input(self) -> bool:
         return False
+
+    def _apply_constraint(self, batch: ColumnBatch) -> ColumnBatch:
+        from .domain_filter import tuple_domain_mask
+
+        mask = tuple_domain_mask(batch, self.constraint, self._name_to_idx)
+        if mask is None or mask.all():
+            return batch
+        self.rows_pruned_by_domain += int(batch.num_rows - mask.sum())
+        return batch.filter(mask)
 
     def _apply_dynamic_filters(self, batch: ColumnBatch) -> ColumnBatch:
         mask = None
@@ -133,8 +149,15 @@ class ScanOperator(Operator):
             if self._source is None:
                 if not self.splits:
                     return None
-                self._source = self.connector.create_page_source(
-                    self.splits.pop(0), self.columns)
+                # kwarg only when constrained: wrapper connectors with the
+                # bare (split, columns) signature keep working
+                if self.constraint is not None:
+                    self._source = self.connector.create_page_source(
+                        self.splits.pop(0), self.columns,
+                        constraint=self.constraint)
+                else:
+                    self._source = self.connector.create_page_source(
+                        self.splits.pop(0), self.columns)
             if self._source.is_finished():
                 self._source.close()
                 self._source = None
@@ -144,6 +167,10 @@ class ScanOperator(Operator):
                 # device-pinned batches (live mask set) skip host-side
                 # dynamic filtering — pulling them down would cost more
                 # than the pruning saves
+                if self.constraint is not None and batch.live is None:
+                    batch = self._apply_constraint(batch)
+                    if batch.num_rows == 0:
+                        continue
                 if self.dynamic_filters and batch.live is None:
                     batch = self._apply_dynamic_filters(batch)
                     if batch.num_rows == 0:
@@ -1326,6 +1353,66 @@ class GroupIdOperator(Operator):
 
     def is_finished(self) -> bool:
         return self.input_done and not self._queue
+
+
+class UnnestOperator(Operator):
+    """Array row expansion (reference: operator/unnest/UnnestOperator.java:42).
+    Host-side by design: fan-out is inherently dynamic-shape, and array
+    values live in the host dictionary (spi/types.ArrayType).  Multiple
+    arrays zip-pad to the longest per row (Trino semantics); rows where
+    every array is empty/NULL are dropped (CROSS JOIN UNNEST)."""
+
+    def __init__(self, replicate, unnest_channels, ordinality, output_names,
+                 output_types):
+        self.replicate = list(replicate)
+        self.unnest_channels = list(unnest_channels)
+        self.ordinality = ordinality
+        self.output_names = list(output_names)
+        self.output_types = list(output_types)
+        self._pending: Optional[ColumnBatch] = None
+
+    def needs_input(self) -> bool:
+        return self._pending is None and super().needs_input()
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        batch = batch.compact()
+        n = batch.num_rows
+        if n == 0:
+            return
+        per_col: list[list[tuple]] = []
+        for ch in self.unnest_channels:
+            c = batch.columns[ch]
+            codes = np.asarray(c.data)
+            valid = c.valid_mask()
+            d = c.dictionary
+            per_col.append([
+                tuple(d[codes[i]]) if valid[i] else () for i in range(n)])
+        lengths = np.array(
+            [max(len(a[i]) for a in per_col) for i in range(n)],
+            dtype=np.int64)
+        idx = np.repeat(np.arange(n), lengths)
+        if not len(idx):
+            return
+        pos = np.concatenate([np.arange(l) for l in lengths if l])
+        cols = [batch.columns[ch].take(idx) for ch in self.replicate]
+        k = len(self.replicate)
+        for j in range(len(per_col)):
+            et = self.output_types[k + j]
+            vals = [
+                per_col[j][r][p] if p < len(per_col[j][r]) else None
+                for r, p in zip(idx, pos)]
+            cols.append(Column.from_values(et, vals))
+        if self.ordinality:
+            cols.append(Column(self.output_types[-1],
+                               (pos + 1).astype(np.int64)))
+        self._pending = ColumnBatch(self.output_names, cols)
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        b, self._pending = self._pending, None
+        return b
+
+    def is_finished(self) -> bool:
+        return self.input_done and self._pending is None
 
 
 class ReplicateOperator(Operator):

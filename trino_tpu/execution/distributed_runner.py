@@ -49,10 +49,30 @@ class DistributedQueryRunner:
     def __init__(self, catalog: Optional[Catalog] = None,
                  worker_count: int = 3,
                  session: Optional[Session] = None):
+        from .control import (
+            DispatchManager,
+            HeartbeatFailureDetector,
+            NodeManager,
+            ResourceGroup,
+        )
+
         self.catalog = catalog if catalog is not None else default_catalog()
         self.worker_count = worker_count
         self.session = session if session is not None else Session(
             node_count=worker_count)
+        # control plane: discovery (in-process workers announce at boot),
+        # heartbeat-gated membership, resource-group admission + query FSM
+        self.nodes = NodeManager()
+        self.nodes.announce("coordinator", coordinator=True)
+        for i in range(worker_count):
+            self.nodes.announce(f"worker-{i}")
+        self.failure_detector = HeartbeatFailureDetector(self.nodes)
+        for i in range(worker_count):
+            self.failure_detector.monitor(f"worker-{i}", lambda: True)
+        self.dispatcher = DispatchManager(ResourceGroup(
+            "global",
+            hard_concurrency_limit=self.session.query_concurrency,
+            max_queued=self.session.query_max_queued))
 
     # ------------------------------------------------------------------ plan
     def create_plan(self, sql: str) -> PlanNode:
@@ -97,8 +117,17 @@ class DistributedQueryRunner:
                 fragment_plan(self._plan_stmt(st)), None))
         if ddl is not None:
             return ddl
-        subplan = fragment_plan(self._plan_stmt(stmt))
-        return self._execute_subplan(subplan, None)
+
+        def run(fsm):
+            fsm.set("PLANNING")
+            subplan = fragment_plan(self._plan_stmt(stmt))
+            fsm.set("STARTING")
+            fsm.set("RUNNING")
+            out = self._execute_subplan(subplan, None)
+            fsm.set("FINISHING")
+            return out
+
+        return self.dispatcher.submit(sql, self.session, run)
 
     def _execute_subplan(self, subplan: SubPlan,
                          stats_sink: Optional[list]) -> QueryResult:
@@ -192,12 +221,25 @@ class DistributedQueryRunner:
                 batches.append(maybe_deserialize(b))
         return self._to_result(subplan, batches)
 
+    @property
+    def active_worker_count(self) -> int:
+        """Live, non-draining workers per discovery + failure detection;
+        falls back to the static count if the control plane sees none
+        (mirrors NodeScheduler consulting the FailureDetector)."""
+        # on-demand heartbeat round (deterministic without the background
+        # pinger thread; start() enables continuous monitoring)
+        self.failure_detector.ping_once()
+        alive = [w for w in self.nodes.active_workers()
+                 if w not in self.failure_detector.failed_nodes()]
+        return len(alive) or self.worker_count
+
     def stage_task_counts(self, fragments) -> tuple[dict, dict]:
         """(fragment -> task count, fragment -> consumer task count); the
         output-buffer partition count of a fragment is its consumer's task
         count (the root's consumer is the client: 1)."""
+        workers = self.active_worker_count
         task_counts = {
-            f.id: (1 if f.partitioning == "SINGLE" else self.worker_count)
+            f.id: (1 if f.partitioning == "SINGLE" else workers)
             for f in fragments
         }
         consumer_tasks: dict[int, int] = {}

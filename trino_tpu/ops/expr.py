@@ -42,6 +42,7 @@ from ..spi.types import (
     TIMESTAMP,
     UNKNOWN,
     VARCHAR,
+    ArrayType,
     DecimalType,
     Type,
     is_string,
@@ -766,6 +767,95 @@ def _round_handler(out_type, args):
     return Lowered(out_type, None, fn)
 
 
+# ---------------------------------------------------------------------------
+# array functions: host dictionary transforms + device gathers (same stance
+# as strings; reference operator/scalar/ArrayFunctions / ArraySubscript)
+
+
+def _array_table_lookup(col, values, out_type: Type):
+    """Per-dictionary-code precomputed result table -> device gather.
+    ``values`` holds one python value (or None) per array-dictionary entry;
+    Column.from_values performs type-correct storage conversion (decimal
+    scaling, date days, string re-dictionarying) for the output."""
+    from ..spi.batch import Column
+
+    tab = Column.from_values(out_type, list(values))
+    data_tab = np.asarray(tab.data)
+    valid_tab = tab.valid_mask()
+    all_valid = bool(valid_tab.all())
+
+    def fn(cols: Cols):
+        codes, valid = col.fn(cols)
+        data = jnp.asarray(data_tab)[codes]
+        v = valid if all_valid else _and_valid(
+            valid, jnp.asarray(valid_tab)[codes])
+        return data, v
+
+    return Lowered(out_type, tab.dictionary, fn)
+
+
+def _require_array_dict(col, what: str):
+    if col.dictionary is None:
+        raise NotImplementedError(f"{what} on non-dictionary array column")
+
+
+def _cardinality_handler(out_type, args):
+    col = args[0]
+    _require_array_dict(col, "cardinality")
+    return _array_table_lookup(col, [len(v) for v in col.dictionary], BIGINT)
+
+
+def _element_at_handler(out_type, args):
+    col = args[0]
+    _require_array_dict(col, "element_at")
+    i = _literal_int(args[1])
+    if i == 0:
+        raise NotImplementedError("SQL array indexes are 1-based")
+
+    def pick(v):
+        j = i - 1 if i > 0 else len(v) + i
+        return v[j] if 0 <= j < len(v) else None
+
+    return _and_extra_valid(
+        _array_table_lookup(col, [pick(v) for v in col.dictionary], out_type),
+        args[1:])
+
+
+def _array_needle(x) -> object:
+    if hasattr(x.fn, "_literal_value") and not isinstance(x.type, DecimalType):
+        return x.fn._literal_value
+    if x.dictionary is not None and len(x.dictionary) == 1:
+        return str(x.dictionary[0])
+    raise NotImplementedError("contains/array_position needle must be a "
+                              "non-decimal literal")
+
+
+def _contains_handler(out_type, args):
+    col = args[0]
+    _require_array_dict(col, "contains")
+    needle = _array_needle(args[1])
+    return _and_extra_valid(
+        _array_table_lookup(
+            col, [needle in v for v in col.dictionary], BOOLEAN),
+        args[1:])
+
+
+def _array_position_handler(out_type, args):
+    col = args[0]
+    _require_array_dict(col, "array_position")
+    needle = _array_needle(args[1])
+
+    def pos(v):
+        try:
+            return v.index(needle) + 1
+        except ValueError:
+            return 0
+
+    return _and_extra_valid(
+        _array_table_lookup(col, [pos(v) for v in col.dictionary], BIGINT),
+        args[1:])
+
+
 def _grouping_mask_handler(out_type, args):
     """grouping() lowering: constant-table gather by the $groupid channel
     (args = [groupid column, one mask literal per grouping set])."""
@@ -781,6 +871,10 @@ def _grouping_mask_handler(out_type, args):
 
 HANDLERS: dict[str, Callable] = {
     "$grouping_mask": _grouping_mask_handler,
+    "cardinality": _cardinality_handler,
+    "element_at": _element_at_handler,
+    "contains": _contains_handler,
+    "array_position": _array_position_handler,
     "add": _arith_handler("add"),
     "subtract": _arith_handler("subtract"),
     "multiply": _arith_handler("multiply"),
@@ -886,7 +980,19 @@ def _lower(
             def fn_null(cols: Cols):
                 return jnp.zeros((), dtype=t.storage_dtype), jnp.zeros((), dtype=bool)
 
+            if isinstance(t, ArrayType):
+                d0 = np.empty(1, dtype=object)
+                d0[0] = ()
+                return Lowered(t, d0, fn_null)
             return Lowered(t, np.array([""], dtype=object) if is_string(t) else None, fn_null)
+        if isinstance(t, ArrayType):
+            d = np.empty(1, dtype=object)
+            d[0] = tuple(v)
+
+            def fn_arr(cols: Cols):
+                return jnp.zeros((), dtype=np.int32), None
+
+            return Lowered(t, d, fn_arr)
         if is_string(t):
             d = np.array([v], dtype=object)
 

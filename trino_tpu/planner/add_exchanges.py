@@ -46,6 +46,7 @@ from .plan import (
     TableWriter,
     TopN,
     Union,
+    Unnest,
     Values,
     Window,
 )
@@ -164,7 +165,7 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         src = _visit(node.source, single=True)
         return _replace_source(node, src)
 
-    if isinstance(node, (Filter, Project, Replicate, GroupId)):
+    if isinstance(node, (Filter, Project, Replicate, GroupId, Unnest)):
         src = _visit(node.source, single=single)
         return _replace_source(node, src)
 

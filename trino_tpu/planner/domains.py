@@ -1,0 +1,139 @@
+"""Filter predicate -> TupleDomain extraction (pushdown framework).
+
+The role of the reference's DomainTranslator (sql/planner/DomainTranslator
+.java: fromPredicate) feeding PushPredicateIntoTableScan: walk a Filter's
+conjuncts over a TableScan and derive per-column Domains from the
+deterministic comparisons.  The result is ADVISORY (enforced=false): the
+Filter stays in the plan for exactness, the scan uses the TupleDomain to
+prune batches/splits and mask rows before they are padded and shipped to
+the device.
+
+Literal values convert to STORAGE space (decimal -> scaled int, date ->
+epoch days, timestamp -> micros) so connectors compare against raw column
+arrays; strings stay python str (compared through the dictionary)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..spi.batch import _to_days, _to_micros, _to_scaled_int
+from ..spi.predicate import Domain, Range, TupleDomain, ValueSet
+from ..spi.types import DATE, TIMESTAMP, DecimalType, Type, is_string
+from ..sql.ir import Call, InputRef, Literal, RowExpression
+
+__all__ = ["extract_tuple_domain", "storage_value"]
+
+
+def storage_value(t: Type, v):
+    """Python literal -> storage-space comparable (matches Column.from_values)."""
+    if v is None:
+        return None
+    if isinstance(t, DecimalType):
+        return _to_scaled_int(v, t.scale)
+    if t == DATE:
+        return _to_days(v)
+    if t == TIMESTAMP:
+        return _to_micros(v)
+    if is_string(t):
+        return str(v)
+    if t.name == "boolean":
+        return bool(v)
+    return v
+
+
+def _column_literal(c: Call) -> Optional[tuple[InputRef, object, bool]]:
+    """Match (InputRef, Literal) or (Literal, InputRef); bool = flipped."""
+    a, b = c.args
+    if isinstance(a, InputRef) and isinstance(b, Literal):
+        return a, storage_value(a.type, b.value), False
+    if isinstance(b, InputRef) and isinstance(a, Literal):
+        return b, storage_value(b.type, a.value), True
+    return None
+
+
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def _conjunct_domain(c: RowExpression) -> Optional[tuple[int, Domain]]:
+    """One conjunct -> (channel, Domain), or None if not expressible."""
+    if not isinstance(c, Call):
+        return None
+    name = c.name
+    if name in ("eq", "lt", "le", "gt", "ge"):
+        m = _column_literal(c)
+        if m is None:
+            return None
+        ref, v, flipped = m
+        if v is None:
+            return ref.index, Domain.none()  # x <cmp> NULL is never true
+        if flipped and name in _FLIP:
+            name = _FLIP[name]
+        if name == "eq":
+            return ref.index, Domain(ValueSet.of([v]), False)
+        if name == "lt":
+            return ref.index, Domain(ValueSet((Range(None, False, v, False),)), False)
+        if name == "le":
+            return ref.index, Domain(ValueSet((Range(None, False, v, True),)), False)
+        if name == "gt":
+            return ref.index, Domain(ValueSet((Range(v, False, None, False),)), False)
+        return ref.index, Domain(ValueSet((Range(v, True, None, False),)), False)
+    if name == "$in":
+        col = c.args[0]
+        if not isinstance(col, InputRef):
+            return None
+        vals = []
+        for a in c.args[1:]:
+            if not isinstance(a, Literal):
+                return None
+            sv = storage_value(col.type, a.value)
+            if sv is not None:
+                vals.append(sv)
+        return col.index, Domain(ValueSet.of(vals), False)
+    if name == "$is_null" and isinstance(c.args[0], InputRef):
+        return c.args[0].index, Domain.only_null()
+    if (name == "$not" and isinstance(c.args[0], Call)
+            and c.args[0].name == "$is_null"
+            and isinstance(c.args[0].args[0], InputRef)):
+        return c.args[0].args[0].index, Domain(ValueSet.all(), False)
+    if name == "$or":
+        # single-column OR: union the arm domains (x = 1 OR x IN (3, 4))
+        arms = [_conjunct_domain(a) for a in c.args]
+        if any(a is None for a in arms):
+            return None
+        chans = {ch for ch, _ in arms}
+        if len(chans) != 1:
+            return None
+        dom = arms[0][1]
+        for _, d in arms[1:]:
+            dom = dom.union(d)
+        return arms[0][0], dom
+    return None
+
+
+def _split_and(e: RowExpression) -> list[RowExpression]:
+    if isinstance(e, Call) and e.name == "$and":
+        out = []
+        for a in e.args:
+            out.extend(_split_and(a))
+        return out
+    return [e]
+
+
+def extract_tuple_domain(predicate: RowExpression,
+                         channel_to_column: dict[int, str]) -> TupleDomain:
+    """Derive the TupleDomain a Filter implies over named scan columns.
+    Conjuncts that are not simple column-vs-literal comparisons are ignored
+    (sound: the domain only widens)."""
+    td = TupleDomain.all()
+    for c in _split_and(predicate):
+        m = _conjunct_domain(c)
+        if m is None:
+            continue
+        ch, dom = m
+        col = channel_to_column.get(ch)
+        if col is None:
+            continue
+        td = td.intersect(TupleDomain({col: dom}))
+        if td.is_none:
+            return td
+    return td

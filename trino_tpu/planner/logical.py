@@ -52,6 +52,7 @@ from .plan import (
     TableWriter,
     TopN,
     Union,
+    Unnest,
     Values,
     Window,
     WindowFunc,
@@ -289,26 +290,28 @@ class LogicalPlanner:
         dummy = RelationPlan(
             Values(("_row",), (BIGINT,), rows=((0,),)), [None])
         tr = Translator(dummy.scope(outer))
-        from ..spi.types import common_super_type
-
         rows_ir = [[tr.translate(e) for e in row] for row in body.rows]
-        types: list[Type] = list(e.type for e in rows_ir[0])
-        for r in rows_ir[1:]:
-            for i in range(width):
-                c = common_super_type(types[i], r[i].type)
-                if c is None:
-                    raise AnalysisError(
-                        f"VALUES column {i + 1} type mismatch: "
-                        f"{types[i]} vs {r[i].type}")
-                types[i] = c
-        if any(t == UNKNOWN for t in types):
-            raise AnalysisError("VALUES column is entirely NULL; add a CAST")
-        names = tuple(f"_col{i}" for i in range(width))
         if all(isinstance(e, Literal) for r in rows_ir for e in r):
+            from ..spi.types import common_super_type
+
+            types: list[Type] = list(e.type for e in rows_ir[0])
+            for r in rows_ir[1:]:
+                for i in range(width):
+                    c = common_super_type(types[i], r[i].type)
+                    if c is None:
+                        raise AnalysisError(
+                            f"VALUES column {i + 1} type mismatch: "
+                            f"{types[i]} vs {r[i].type}")
+                    types[i] = c
+            if any(t == UNKNOWN for t in types):
+                raise AnalysisError(
+                    "VALUES column is entirely NULL; add a CAST")
+            names = tuple(f"_col{i}" for i in range(width))
             rows = tuple(tuple(e.value for e in r) for r in rows_ir)
             return RelationPlan(Values(names, tuple(types), rows),
                                 [None] * width)
-        # computed expressions: UNION ALL of single-row selects
+        # computed expressions: UNION ALL of single-row selects (plan_setop
+        # performs the per-column coercions)
         def spec_of(row) -> ast.QueryBody:
             return ast.QuerySpec(tuple(ast.SelectItem(e) for e in row))
 
@@ -891,11 +894,64 @@ class LogicalPlanner:
                         f"but relation has {rel.width} columns")
                 node = replace(node, output_names=tuple(r.column_names))
             return RelationPlan(node, [r.alias] * rel.width)
+        if isinstance(r, ast.UnnestRelation):
+            return self._plan_unnest(None, r, outer, ctes)
         if isinstance(r, ast.Join):
             return self.plan_join(r, outer, ctes)
         raise AnalysisError(f"unsupported relation: {type(r).__name__}")
 
+    def _plan_unnest(self, left: Optional[RelationPlan],
+                     u: ast.UnnestRelation, outer, ctes) -> RelationPlan:
+        """UNNEST as a relation (reference: RelationPlanner.planJoinUnnest /
+        plan(Unnest)): lateral — array arguments see the left relation's
+        columns; standalone UNNEST runs over one synthetic row and emits
+        only the element columns."""
+        from ..spi.types import ArrayType
+
+        standalone = left is None
+        if standalone:
+            left = RelationPlan(
+                Values(("_row",), (BIGINT,), rows=((0,),)), [None])
+        orig_width = left.width
+        tr = Translator(left.scope(outer))
+        irs = [tr.translate(e) for e in u.exprs]
+        for ir in irs:
+            if not isinstance(ir.type, ArrayType):
+                raise AnalysisError("UNNEST argument must be an array")
+        chans, left = _as_channels(irs, left)
+        replicate = () if standalone else tuple(range(orig_width))
+
+        n_el = len(irs)
+        el_names = [f"_unnest{i}" for i in range(n_el)]
+        ord_name = "ordinality"
+        if u.column_names:
+            expect = n_el + (1 if u.ordinality else 0)
+            if len(u.column_names) != expect:
+                raise AnalysisError(
+                    f"UNNEST column alias list has {len(u.column_names)} "
+                    f"names but produces {expect} columns")
+            el_names = list(u.column_names[:n_el])
+            if u.ordinality:
+                ord_name = u.column_names[-1]
+        names = tuple([left.node.output_names[c] for c in replicate]
+                      + el_names + ([ord_name] if u.ordinality else []))
+        types = tuple([left.node.output_types[c] for c in replicate]
+                      + [ir.type.element for ir in irs]
+                      + ([BIGINT] if u.ordinality else []))
+        node = Unnest(names, types, left.node, replicate, tuple(chans),
+                      u.ordinality)
+        quals = ([left.qualifiers[c] for c in replicate]
+                 + [u.alias] * (n_el + (1 if u.ordinality else 0)))
+        return RelationPlan(node, quals)
+
     def plan_join(self, j: ast.Join, outer, ctes) -> RelationPlan:
+        if isinstance(j.right, ast.UnnestRelation):
+            # lateral CROSS JOIN UNNEST(left.col)
+            if j.join_type not in ("CROSS", "INNER") or j.condition is not None:
+                raise AnalysisError(
+                    "only CROSS JOIN UNNEST (no condition) is supported")
+            left = self.plan_relation(j.left, outer, ctes)
+            return self._plan_unnest(left, j.right, outer, ctes)
         left = self.plan_relation(j.left, outer, ctes)
         right = self.plan_relation(j.right, outer, ctes)
         names = tuple(left.node.output_names) + tuple(right.node.output_names)

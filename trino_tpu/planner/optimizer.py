@@ -41,6 +41,7 @@ from .plan import (
     TableWriter,
     TopN,
     Union,
+    Unnest,
     Values,
     Window,
 )
@@ -54,7 +55,37 @@ def optimize(root: PlanNode, catalog: Catalog) -> PlanNode:
     node, mapping = _rewrite(root, catalog)
     assert mapping == list(range(len(node.output_types))), "root remap escaped"
     node = _prune(node, set(range(len(node.output_types))))[0]
+    node = _attach_scan_constraints(node)
     return node
+
+
+def _attach_scan_constraints(node: PlanNode) -> PlanNode:
+    """Final pass: Filter directly over TableScan derives an advisory
+    TupleDomain on the scan (planner/domains.py; reference:
+    PushPredicateIntoTableScan.java with enforced=false — the Filter stays)."""
+    from .domains import extract_tuple_domain
+
+    if isinstance(node, Filter) and isinstance(node.source, TableScan):
+        scan = node.source
+        td = extract_tuple_domain(
+            node.predicate,
+            {i: scan.columns[i] for i in range(len(scan.columns))})
+        if not td.is_all:
+            return replace(node, source=replace(scan, constraint=td))
+        return node
+    kids = node.children
+    if not kids:
+        return node
+    new_kids = [_attach_scan_constraints(c) for c in kids]
+    if all(a is b for a, b in zip(kids, new_kids)):
+        return node
+    if isinstance(node, Union):
+        return replace(node, sources=tuple(new_kids))
+    if len(kids) == 1:
+        return replace(node, source=new_kids[0])
+    return (replace(node, left=new_kids[0], right=new_kids[1])
+            if hasattr(node, "left")
+            else replace(node, source=new_kids[0], filter_source=new_kids[1]))
 
 
 # --------------------------------------------------------------------------
@@ -181,6 +212,8 @@ def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
         return sum(estimate_rows(s, catalog) for s in node.sources)
     if isinstance(node, GroupId):
         return estimate_rows(node.source, catalog) * max(1, len(node.sets))
+    if isinstance(node, Unnest):
+        return estimate_rows(node.source, catalog) * 3.0  # avg fan-out guess
     for c in node.children:
         return estimate_rows(c, catalog)
     return 1000.0
@@ -286,6 +319,13 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
         out = replace(node, source=child,
                       key_channels=tuple(m[c] for c in node.key_channels),
                       passthrough=tuple(m[c] for c in node.passthrough))
+        return out, _identity(node)
+
+    if isinstance(node, Unnest):
+        child, m = _rewrite(node.source, catalog)
+        out = replace(node, source=child,
+                      replicate=tuple(m[c] for c in node.replicate),
+                      unnest_channels=tuple(m[c] for c in node.unnest_channels))
         return out, _identity(node)
 
     if isinstance(node, Window):
@@ -730,6 +770,14 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
         out = replace(node, source=child,
                       key_channels=tuple(cm[c] for c in node.key_channels),
                       passthrough=tuple(cm[c] for c in node.passthrough))
+        return out, list(range(len(node.output_types)))
+
+    if isinstance(node, Unnest):
+        child_needed = set(node.replicate) | set(node.unnest_channels)
+        child, cm = _prune(node.source, child_needed)
+        out = replace(node, source=child,
+                      replicate=tuple(cm[c] for c in node.replicate),
+                      unnest_channels=tuple(cm[c] for c in node.unnest_channels))
         return out, list(range(len(node.output_types)))
 
     if isinstance(node, Window):

@@ -20,7 +20,7 @@ from ..sql.ir import RowExpression
 
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
-    "GroupId",
+    "GroupId", "Unnest",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
     "Window", "WindowFunc", "Union", "Replicate", "plan_text",
@@ -45,9 +45,17 @@ class TableScan(PlanNode):
     catalog: str = ""
     table: str = ""
     columns: tuple[str, ...] = ()  # connector column names, 1:1 with outputs
+    # advisory TupleDomain from predicate pushdown (spi/predicate.py;
+    # reference: PushPredicateIntoTableScan with enforced=false) — excluded
+    # from eq/hash (it is derived state, and TupleDomain holds a dict)
+    constraint: Optional[object] = field(default=None, compare=False)
 
     def label(self) -> str:
-        return f"TableScan[{self.catalog}.{self.table} {list(self.columns)}]"
+        c = ""
+        if self.constraint is not None and not self.constraint.is_all:
+            cols = sorted(self.constraint.domains)
+            c = f" constraint={cols}"
+        return f"TableScan[{self.catalog}.{self.table} {list(self.columns)}{c}]"
 
 
 @dataclass(frozen=True)
@@ -127,6 +135,29 @@ class GroupId(PlanNode):
     def label(self) -> str:
         return (f"GroupId[keys={list(self.key_channels)} "
                 f"sets={[list(s) for s in self.sets]}]")
+
+
+@dataclass(frozen=True)
+class Unnest(PlanNode):
+    """Array row expansion (reference: sql/planner/plan/UnnestNode.java,
+    operator/unnest/UnnestOperator.java:42).  Output channels =
+    [``replicate`` source channels] ++ [one element column per
+    ``unnest_channels`` array column] ++ [ordinality BIGINT when set].
+    Standalone ``FROM UNNEST(...)`` uses an empty ``replicate``; the lateral
+    CROSS JOIN UNNEST form replicates the left side's channels."""
+
+    source: PlanNode = None
+    replicate: tuple[int, ...] = ()
+    unnest_channels: tuple[int, ...] = ()
+    ordinality: bool = False
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return (f"Unnest[{list(self.unnest_channels)}"
+                + (" ordinality" if self.ordinality else "") + "]")
 
 
 @dataclass(frozen=True)

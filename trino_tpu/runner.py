@@ -164,6 +164,10 @@ class Session:
     # MLFQ quanta (TimeSharingTaskExecutor)
     task_scheduler: str = "THREADS"
     executor_workers: int = 4
+    # dispatcher admission: concurrent queries per runner (resource groups;
+    # reference: execution/resourcegroups/InternalResourceGroup.java:75)
+    query_concurrency: int = 16
+    query_max_queued: int = 200
 
 
 class StandaloneQueryRunner:

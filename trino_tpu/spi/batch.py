@@ -35,6 +35,7 @@ from .types import (
     DATE,
     DOUBLE,
     TIMESTAMP,
+    ArrayType,
     DecimalType,
     Type,
     days_to_date,
@@ -60,6 +61,31 @@ def encode_strings(values: Sequence[str | None]) -> tuple[np.ndarray, np.ndarray
     filled = np.array([v if v is not None else "" for v in values], dtype=object)
     dictionary, codes = np.unique(filled, return_inverse=True)
     return codes.astype(np.int32), valid, dictionary
+
+
+def _canon_key(v):
+    """Deterministic sort key for array dictionary entries: lexicographic
+    with NULL elements last (comparisons must never hit None<x)."""
+    return tuple((e is None, e if e is not None else 0) for e in v)
+
+
+def _object_array(values) -> np.ndarray:
+    # np.array(list_of_equal_len_tuples) would build a 2-D array; fill by slot
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def encode_arrays(values: Sequence) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Encode python sequences (arrays) into (codes, valid, dictionary of
+    tuples).  Same contract as encode_strings, tuple-valued dictionary."""
+    valid = np.array([v is not None for v in values], dtype=np.bool_)
+    filled = [tuple(v) if v is not None else () for v in values]
+    uniq = sorted(set(filled), key=_canon_key)
+    pos = {v: i for i, v in enumerate(uniq)}
+    codes = np.array([pos[v] for v in filled], dtype=np.int32)
+    return codes, valid, _object_array(uniq)
 
 
 @dataclass
@@ -101,6 +127,9 @@ class Column:
     @staticmethod
     def from_values(type_: Type, values: Sequence) -> "Column":
         """Build a column from python values (None = NULL)."""
+        if isinstance(type_, ArrayType):
+            codes, valid, dictionary = encode_arrays(values)
+            return Column(type_, codes, valid, dictionary)
         if type_.is_dictionary_encoded:
             codes, valid, dictionary = encode_strings(values)
             return Column(type_, codes, valid, dictionary)
@@ -135,7 +164,11 @@ class Column:
         valid = self.valid_mask()
         t = self.type
         out: list = []
-        if t.is_dictionary_encoded:
+        if isinstance(t, ArrayType):
+            d = self.dictionary
+            for i in range(len(self)):
+                out.append(list(d[data[i]]) if valid[i] else None)
+        elif t.is_dictionary_encoded:
             d = self.dictionary
             for i in range(len(self)):
                 out.append(str(d[data[i]]) if valid[i] else None)
@@ -200,6 +233,8 @@ def unify_dictionaries(columns: Sequence[Column]) -> list[Column]:
     first = dicts[0]
     if all(d is first or (d.shape == first.shape and (d == first).all()) for d in dicts):
         return list(columns)
+    if any(len(d) and isinstance(d[0], tuple) for d in dicts):
+        return _unify_object_dictionaries(columns, dicts)
     merged = np.unique(np.concatenate(dicts))
     out = []
     for c, d in zip(columns, dicts):
@@ -210,6 +245,27 @@ def unify_dictionaries(columns: Sequence[Column]) -> list[Column]:
         elif isinstance(c.data, np.ndarray):
             data = remap[c.data]
         else:  # device codes: gather the (tiny) remap table on device
+            import jax.numpy as jnp
+
+            data = jnp.asarray(remap)[c.data]
+        out.append(Column(c.type, data, c.valid, merged))
+    return out
+
+
+def _unify_object_dictionaries(columns: Sequence[Column], dicts) -> list[Column]:
+    """Array-dictionary variant of unify_dictionaries: tuples with possible
+    None elements are not numpy-sortable, so merge with the canonical key."""
+    merged_list = sorted({x for d in dicts for x in d}, key=_canon_key)
+    pos = {v: i for i, v in enumerate(merged_list)}
+    merged = _object_array(merged_list)
+    out = []
+    for c, d in zip(columns, dicts):
+        remap = np.array([pos[v] for v in d], dtype=np.int32)
+        if not len(d):
+            data = np.zeros(len(c), dtype=np.int32)
+        elif isinstance(c.data, np.ndarray):
+            data = remap[c.data]
+        else:
             import jax.numpy as jnp
 
             data = jnp.asarray(remap)[c.data]

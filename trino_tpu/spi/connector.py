@@ -132,7 +132,12 @@ class Connector:
     def get_splits(self, table: str, splits_per_node: int, node_count: int) -> list[Split]:
         raise NotImplementedError
 
-    def create_page_source(self, split: Split, columns: Sequence[str]) -> ConnectorPageSource:
+    def create_page_source(self, split: Split, columns: Sequence[str],
+                           constraint=None) -> ConnectorPageSource:
+        """``constraint`` is an advisory spi/predicate.TupleDomain the
+        connector MAY use to skip data (batches/splits); it need not enforce
+        it (mirrors ConnectorPageSourceProvider.createPageSource receiving a
+        dynamicFilter/TupleDomain it can use for pruning)."""
         raise NotImplementedError
 
     # --- writes -----------------------------------------------------------

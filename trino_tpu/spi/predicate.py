@@ -1,0 +1,260 @@
+"""TupleDomain / Domain / ValueSet — the predicate algebra.
+
+Mirrors ``core/trino-spi/src/main/java/io/trino/spi/predicate``
+(TupleDomain.java:56, Domain.java:41, SortedRangeSet / EquatableValueSet):
+the lingua franca for predicate pushdown, dynamic filters, and split/batch
+pruning.  Values are host python comparables (ints, floats, strs, date
+ordinals...) — domains describe *data*, they never touch the device; the
+engine uses them to skip work before columns are padded and shipped to HBM.
+
+Simplifications vs the reference: one range-set representation (points are
+degenerate ranges) instead of Sorted/Equatable split; no type-specific
+successor logic (ranges stay half-open/closed as written).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+__all__ = ["Range", "ValueSet", "Domain", "TupleDomain"]
+
+_NEG_INF = object()
+_POS_INF = object()
+
+
+@dataclass(frozen=True)
+class Range:
+    """[low, high] with per-bound inclusivity; None bound = unbounded
+    (reference: spi/predicate/Range.java)."""
+
+    low: object = None  # None = -inf
+    low_inclusive: bool = False
+    high: object = None  # None = +inf
+    high_inclusive: bool = False
+
+    @staticmethod
+    def point(v) -> "Range":
+        return Range(v, True, v, True)
+
+    @property
+    def is_point(self) -> bool:
+        return (self.low is not None and self.low == self.high
+                and self.low_inclusive and self.high_inclusive)
+
+    def contains_value(self, v) -> bool:
+        if self.low is not None:
+            if v < self.low or (v == self.low and not self.low_inclusive):
+                return False
+        if self.high is not None:
+            if v > self.high or (v == self.high and not self.high_inclusive):
+                return False
+        return True
+
+    def overlaps(self, other: "Range") -> bool:
+        return not (self._strictly_before(other) or other._strictly_before(self))
+
+    def _strictly_before(self, other: "Range") -> bool:
+        if self.high is None or other.low is None:
+            return False
+        if self.high < other.low:
+            return True
+        if self.high == other.low:
+            return not (self.high_inclusive and other.low_inclusive)
+        return False
+
+    def intersect(self, other: "Range") -> Optional["Range"]:
+        if not self.overlaps(other):
+            return None
+        if self.low is None:
+            low, li = other.low, other.low_inclusive
+        elif other.low is None or self.low > other.low:
+            low, li = self.low, self.low_inclusive
+        elif self.low < other.low:
+            low, li = other.low, other.low_inclusive
+        else:
+            low, li = self.low, self.low_inclusive and other.low_inclusive
+        if self.high is None:
+            high, hi = other.high, other.high_inclusive
+        elif other.high is None or self.high < other.high:
+            high, hi = self.high, self.high_inclusive
+        elif self.high > other.high:
+            high, hi = other.high, other.high_inclusive
+        else:
+            high, hi = self.high, self.high_inclusive and other.high_inclusive
+        return Range(low, li, high, hi)
+
+
+@dataclass(frozen=True)
+class ValueSet:
+    """Union of ranges (reference: spi/predicate/SortedRangeSet.java).
+    ``ranges == ()`` means none (empty set); ``is_all`` marks the universe."""
+
+    ranges: tuple[Range, ...] = ()
+    is_all: bool = False
+
+    @staticmethod
+    def all() -> "ValueSet":
+        return ValueSet((), True)
+
+    @staticmethod
+    def none() -> "ValueSet":
+        return ValueSet(())
+
+    @staticmethod
+    def of(values: Iterable) -> "ValueSet":
+        return ValueSet(tuple(Range.point(v) for v in sorted(set(values))))
+
+    @property
+    def is_none(self) -> bool:
+        return not self.is_all and not self.ranges
+
+    def contains_value(self, v) -> bool:
+        if self.is_all:
+            return True
+        return any(r.contains_value(v) for r in self.ranges)
+
+    def overlaps_range(self, low, high) -> bool:
+        """Does any value in [low, high] (both inclusive) belong to the set?
+        The batch/split pruning primitive: min/max stats form the probe."""
+        if self.is_all:
+            return True
+        probe = Range(low, True, high, True)
+        return any(r.overlaps(probe) for r in self.ranges)
+
+    def intersect(self, other: "ValueSet") -> "ValueSet":
+        if self.is_all:
+            return other
+        if other.is_all:
+            return self
+        out = []
+        for a in self.ranges:
+            for b in other.ranges:
+                c = a.intersect(b)
+                if c is not None:
+                    out.append(c)
+        return ValueSet(tuple(out))
+
+    def union(self, other: "ValueSet") -> "ValueSet":
+        if self.is_all or other.is_all:
+            return ValueSet.all()
+        return ValueSet(self.ranges + other.ranges)
+
+    def points(self) -> Optional[list]:
+        """The discrete values when every range is a point, else None."""
+        if self.is_all or any(not r.is_point for r in self.ranges):
+            return None
+        return [r.low for r in self.ranges]
+
+
+@dataclass(frozen=True)
+class Domain:
+    """ValueSet + NULL admissibility (reference: spi/predicate/Domain.java:41)."""
+
+    values: ValueSet = field(default_factory=ValueSet.all)
+    null_allowed: bool = False
+
+    @staticmethod
+    def all() -> "Domain":
+        return Domain(ValueSet.all(), True)
+
+    @staticmethod
+    def none() -> "Domain":
+        return Domain(ValueSet.none(), False)
+
+    @staticmethod
+    def single_value(v) -> "Domain":
+        return Domain(ValueSet.of([v]), False)
+
+    @staticmethod
+    def only_null() -> "Domain":
+        return Domain(ValueSet.none(), True)
+
+    @property
+    def is_all(self) -> bool:
+        return self.values.is_all and self.null_allowed
+
+    @property
+    def is_none(self) -> bool:
+        return self.values.is_none and not self.null_allowed
+
+    def contains_value(self, v) -> bool:
+        if v is None:
+            return self.null_allowed
+        return self.values.contains_value(v)
+
+    def intersect(self, other: "Domain") -> "Domain":
+        return Domain(self.values.intersect(other.values),
+                      self.null_allowed and other.null_allowed)
+
+    def union(self, other: "Domain") -> "Domain":
+        return Domain(self.values.union(other.values),
+                      self.null_allowed or other.null_allowed)
+
+
+@dataclass(frozen=True)
+class TupleDomain:
+    """Per-column conjunction of domains (reference:
+    spi/predicate/TupleDomain.java:56).  ``domains`` maps column name ->
+    Domain; a column absent from the map is unconstrained.  ``is_none``
+    marks a provably empty relation."""
+
+    domains: dict[str, Domain] = field(default_factory=dict)
+    is_none: bool = False
+
+    @staticmethod
+    def all() -> "TupleDomain":
+        return TupleDomain({})
+
+    @staticmethod
+    def none() -> "TupleDomain":
+        return TupleDomain({}, True)
+
+    @property
+    def is_all(self) -> bool:
+        return not self.is_none and not self.domains
+
+    def domain(self, column: str) -> Domain:
+        return self.domains.get(column, Domain.all())
+
+    def intersect(self, other: "TupleDomain") -> "TupleDomain":
+        if self.is_none or other.is_none:
+            return TupleDomain.none()
+        out = dict(self.domains)
+        for col, d in other.domains.items():
+            nd = out[col].intersect(d) if col in out else d
+            if nd.is_none:
+                return TupleDomain.none()
+            out[col] = nd
+        return TupleDomain(out)
+
+    def column_wise_union(self, other: "TupleDomain") -> "TupleDomain":
+        """OR of tuple domains, exact only per shared column (the reference's
+        columnWiseUnion — a sound over-approximation)."""
+        if self.is_none:
+            return other
+        if other.is_none:
+            return self
+        out = {}
+        for col in set(self.domains) & set(other.domains):
+            out[col] = self.domains[col].union(other.domains[col])
+        return TupleDomain(out)
+
+    def overlaps_stats(self, mins: dict, maxs: dict,
+                       has_null: Optional[dict] = None) -> bool:
+        """Can any row with the given per-column [min, max] (+ null flags)
+        satisfy this tuple domain?  False => the batch/split is prunable."""
+        if self.is_none:
+            return False
+        for col, dom in self.domains.items():
+            if col not in mins or col not in maxs:
+                continue
+            nullable = bool(has_null.get(col)) if has_null else True
+            if mins[col] is None:  # all-NULL column stats
+                if not dom.null_allowed:
+                    return False
+                continue
+            if not dom.values.overlaps_range(mins[col], maxs[col]) and not (
+                    dom.null_allowed and nullable):
+                return False
+        return True

@@ -41,6 +41,7 @@ __all__ = [
     "DATE",
     "TIMESTAMP",
     "DecimalType",
+    "ArrayType",
     "UNKNOWN",
     "parse_type",
     "common_super_type",
@@ -68,7 +69,7 @@ class Type:
 
     @property
     def is_dictionary_encoded(self) -> bool:
-        return self.name in ("varchar", "char")
+        return self.name in ("varchar", "char") or isinstance(self, ArrayType)
 
     def zero_value(self):
         """Neutral fill value for masked-out slots."""
@@ -94,6 +95,25 @@ class DecimalType(Type):
 
     def scale_factor(self) -> int:
         return 10**self.scale
+
+
+@dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(T) (reference: spi/type/ArrayType.java).  TPU-first stance
+    mirrors VARCHAR: array *values* live in a host-side dictionary of python
+    tuples and the device sees int32 codes, so grouping/equality/joins run
+    on codes while array functions (cardinality/element_at/contains) are
+    host dictionary transforms + device gathers — the chip never touches
+    nested layouts.  UNNEST re-expands on host (row expansion is inherently
+    dynamic-shape)."""
+
+    element: "Type" = None
+
+    def __init__(self, element: "Type"):
+        object.__setattr__(self, "name", f"array({element.name})")
+        object.__setattr__(self, "storage_dtype", np.dtype(np.int32))
+        object.__setattr__(self, "_coercion_rank", -1)
+        object.__setattr__(self, "element", element)
 
 
 BOOLEAN = Type("boolean", np.dtype(np.bool_), 0)
@@ -152,6 +172,9 @@ def common_super_type(a: Type, b: Type) -> Type | None:
         return VARCHAR
     if {a.name, b.name} == {DATE.name, TIMESTAMP.name}:
         return TIMESTAMP
+    if isinstance(a, ArrayType) and isinstance(b, ArrayType):
+        e = common_super_type(a.element, b.element)
+        return ArrayType(e) if e is not None else None
     return None
 
 
@@ -186,6 +209,10 @@ def parse_type(text: str) -> Type:
         return DecimalType(prec, scale)
     if t in ("decimal", "numeric"):
         return DecimalType(18, 0)
+    if t.startswith("array(") and t.endswith(")"):
+        return ArrayType(parse_type(t[len("array("):-1]))
+    if t.startswith("array<") and t.endswith(">"):
+        return ArrayType(parse_type(t[len("array<"):-1]))
     raise ValueError(f"unknown type: {text!r}")
 
 

@@ -27,6 +27,7 @@ from ..spi.types import (
     TIMESTAMP,
     UNKNOWN,
     VARCHAR,
+    ArrayType,
     DecimalType,
     Type,
     common_super_type,
@@ -475,6 +476,30 @@ class Translator:
         inner = self.translate(e.operand)
         return cast_to(inner, parse_type(e.type_name))
 
+    # -- arrays ------------------------------------------------------------
+    def _t_ArrayLiteral(self, e: ast.ArrayLiteral) -> RowExpression:
+        elems = [self.translate(x) for x in e.elements]
+        if not all(isinstance(x, Literal) for x in elems):
+            raise AnalysisError(
+                "ARRAY elements must be constants (array values live in a "
+                "host-side dictionary; see spi/types.ArrayType)")
+        et = UNKNOWN
+        for x in elems:
+            c = common_super_type(et, x.type)
+            if c is None:
+                raise AnalysisError("ARRAY element types differ")
+            et = c
+        # ARRAY[] / all-NULL keeps element UNKNOWN; coercion against other
+        # rows/columns resolves it (common_super_type recurses per element)
+        return Literal(ArrayType(et), tuple(x.value for x in elems))
+
+    def _t_Subscript(self, e: ast.Subscript) -> RowExpression:
+        base = self.translate(e.base)
+        if not isinstance(base.type, ArrayType):
+            raise AnalysisError("subscript requires an array")
+        idx = cast_to(self.translate(e.index), BIGINT)
+        return Call(base.type.element, "element_at", (base, idx))
+
     def _t_Extract(self, e: ast.Extract) -> RowExpression:
         inner = self.translate(e.operand)
         fn = e.field_.lower()
@@ -613,6 +638,18 @@ class Translator:
             pa, pb = self._promote_pair(a, b)
             return Call(a.type, "$if",
                         (Call(BOOLEAN, "eq", (pa, pb)), Literal(a.type, None), a))
+        if name in ("cardinality", "element_at", "contains", "array_position"):
+            a = self.translate(e.args[0])
+            if not isinstance(a.type, ArrayType):
+                raise AnalysisError(f"{name} requires an array argument")
+            if name == "cardinality":
+                return Call(BIGINT, "cardinality", (a,))
+            b = self.translate(e.args[1])
+            if name == "element_at":
+                return Call(a.type.element, "element_at",
+                            (a, cast_to(b, BIGINT)))
+            out_t = BOOLEAN if name == "contains" else BIGINT
+            return Call(out_t, name, (a, b))
         if name not in _SCALAR_TYPES:
             raise AnalysisError(f"function not registered: {name}")
         args = tuple(self.translate(a) for a in e.args)

@@ -187,6 +187,22 @@ class FunctionCall(Expr):
 
 
 @dataclass(frozen=True)
+class ArrayLiteral(Expr):
+    """ARRAY[e1, e2, ...] constructor (reference: sql/tree/Array.java)."""
+
+    elements: tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Subscript(Expr):
+    """base[index] — array element access (reference:
+    sql/tree/SubscriptExpression.java)."""
+
+    base: Expr
+    index: Expr
+
+
+@dataclass(frozen=True)
 class Cast(Expr):
     operand: Expr
     type_name: str
@@ -231,6 +247,19 @@ class SubqueryRelation(Relation):
     query: "Query"
     alias: Optional[str] = None
     column_names: Optional[tuple[str, ...]] = None  # AS v(a, b, c)
+
+
+@dataclass(frozen=True)
+class UnnestRelation(Relation):
+    """UNNEST(arr, ...) [WITH ORDINALITY] (reference: sql/tree/Unnest.java;
+    planned as UnnestNode, executed by operator/unnest/UnnestOperator.java:42).
+    Array arguments may reference columns of relations to the left (lateral
+    implicit join, SQL:2016 7.6 <table reference>)."""
+
+    exprs: tuple[Expr, ...]
+    ordinality: bool = False
+    alias: Optional[str] = None
+    column_names: Optional[tuple[str, ...]] = None
 
 
 @dataclass(frozen=True)

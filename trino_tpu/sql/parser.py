@@ -43,7 +43,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||->|[=<>+\-*/%(),.;])
+  | (?P<op><>|!=|>=|<=|\|\||->|[=<>+\-*/%(),.;\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -442,6 +442,29 @@ class _Parser:
             left = ast.Join(jt, left, right, cond)
 
     def parse_relation_primary(self) -> ast.Relation:
+        t = self.cur
+        if (t.kind == "ident" and t.text.lower() == "unnest"
+                and self.tokens[self.i + 1].text == "("):
+            self.advance()
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            ordinality = False
+            if self.peek_kw("with"):
+                self.advance()
+                self.expect_word("ordinality")
+                ordinality = True
+            alias = self._maybe_alias()
+            colnames = None
+            if alias is not None and self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+                colnames = tuple(cols)
+            return ast.UnnestRelation(tuple(exprs), ordinality, alias, colnames)
         if self.accept_op("("):
             q = self.parse_query()
             self.expect_op(")")
@@ -580,11 +603,18 @@ class _Parser:
 
     def parse_postfix(self) -> ast.Expr:
         e = self.parse_primary()
-        while self.accept_op("."):
-            if not isinstance(e, ast.ColumnRef):
-                self.fail("unexpected '.'")
-            e = ast.ColumnRef(e.parts + (self.expect_ident(),))
-        return e
+        while True:
+            if self.accept_op("."):
+                if not isinstance(e, ast.ColumnRef):
+                    self.fail("unexpected '.'")
+                e = ast.ColumnRef(e.parts + (self.expect_ident(),))
+                continue
+            if self.accept_op("["):
+                idx = self.parse_expr()
+                self.expect_op("]")
+                e = ast.Subscript(e, idx)
+                continue
+            return e
 
     def parse_primary(self) -> ast.Expr:
         t = self.cur
@@ -690,6 +720,17 @@ class _Parser:
             return e
         if t.kind == "ident":
             nxt = self.tokens[self.i + 1]
+            if (t.text.lower() == "array" and nxt.kind == "op"
+                    and nxt.text == "["):
+                self.advance()
+                self.advance()
+                elems: list[ast.Expr] = []
+                if not self.peek_op("]"):
+                    elems.append(self.parse_expr())
+                    while self.accept_op(","):
+                        elems.append(self.parse_expr())
+                self.expect_op("]")
+                return ast.ArrayLiteral(tuple(elems))
             if nxt.kind == "op" and nxt.text == "(":
                 name = self.advance().text.lower()
                 self.expect_op("(")

@@ -1,0 +1,127 @@
+"""Transactions, SQL routines (CREATE FUNCTION), table functions, scaled
+writers (reference: transaction/InMemoryTransactionManager.java:72,
+sql/routine/SqlRoutineAnalyzer, operator/table/SequenceFunction.java,
+ScaledWriterScheduler / SCALED_WRITER partitionings)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return StandaloneQueryRunner(default_catalog(scale_factor=0.01),
+                                 session=Session(default_catalog="memory"))
+
+
+# ------------------------------------------------------------ transactions
+def test_rollback_undoes_insert_and_create(runner):
+    runner.execute("create table tx (v bigint)")
+    runner.execute("insert into tx values (1)")
+    runner.execute("start transaction")
+    runner.execute("insert into tx values (2), (3)")
+    runner.execute("create table tx2 (w bigint)")
+    assert runner.execute("select count(*) from tx").rows() == [(3,)]
+    runner.execute("rollback")
+    assert runner.execute("select count(*) from tx").rows() == [(1,)]
+    with pytest.raises(Exception):
+        runner.execute("select * from tx2")
+
+
+def test_commit_keeps_writes(runner):
+    runner.execute("create table tc (v bigint)")
+    runner.execute("begin")
+    runner.execute("insert into tc values (9)")
+    runner.execute("commit")
+    assert runner.execute("select v from tc").rows() == [(9,)]
+
+
+def test_transaction_state_errors(runner):
+    with pytest.raises(ValueError):
+        runner.execute("commit")
+    with pytest.raises(ValueError):
+        runner.execute("rollback")
+    runner.execute("begin")
+    with pytest.raises(ValueError):
+        runner.execute("begin")
+    runner.execute("rollback")
+
+
+# ------------------------------------------------------------ SQL routines
+def test_create_function_and_inline(runner):
+    runner.execute(
+        "create function double_it(x bigint) returns bigint return x * 2")
+    assert runner.execute("select double_it(21)").rows() == [(42,)]
+    # routines call routines; arguments are expressions over columns
+    runner.execute("create function add5(x bigint) returns bigint "
+                   "return double_it(x) + 5 - x")
+    assert runner.execute(
+        "select add5(n_nationkey) from tpch.nation where n_nationkey = 7"
+    ).rows() == [(12,)]
+
+
+def test_function_over_column_and_where(runner):
+    runner.execute("create function sq(x double) returns double return x * x")
+    rows = runner.execute(
+        "select n_nationkey from tpch.nation where sq(n_nationkey) = 49"
+    ).rows()
+    assert rows == [(7,)]
+
+
+def test_drop_function(runner):
+    runner.execute("create function f1(x bigint) returns bigint return x")
+    runner.execute("drop function f1")
+    with pytest.raises(Exception):
+        runner.execute("select f1(1)")
+
+
+def test_recursive_function_rejected(runner):
+    runner.execute("create function r1(x bigint) returns bigint return r1(x)")
+    with pytest.raises(Exception):
+        runner.execute("select r1(1)")
+
+
+# ---------------------------------------------------------- table functions
+def test_sequence(runner):
+    assert runner.execute(
+        "select count(*), sum(sequential_number) "
+        "from table(sequence(1, 100))").rows() == [(100, 5050)]
+
+
+def test_sequence_negative_step(runner):
+    assert runner.execute(
+        "select * from table(sequence(5, 1, -2)) as t(n)").rows() == [
+        (5,), (3,), (1,)]
+
+
+def test_sequence_joins(runner):
+    rows = runner.execute(
+        "select n from table(sequence(0, 4)) as t(n) "
+        "join tpch.region on n = r_regionkey order by n").rows()
+    assert rows == [(0,), (1,), (2,), (3,), (4,)]
+
+
+def test_unknown_table_function(runner):
+    with pytest.raises(Exception):
+        runner.execute("select * from table(nope(1))")
+
+
+# ------------------------------------------------------------ scaled writers
+def test_scaled_writers_round_robin():
+    cat = default_catalog(scale_factor=0.01)
+    d = DistributedQueryRunner(cat, worker_count=3, session=Session(
+        node_count=3, default_catalog="memory", scale_writers=True,
+        writer_task_limit=3))
+    plan = d.explain("create table li2 as select l_orderkey, l_quantity "
+                     "from tpch.lineitem")
+    assert "ROUND_ROBIN" in plan and "ARBITRARY" in plan
+    n = d.execute("create table li2 as select l_orderkey, l_quantity "
+                  "from tpch.lineitem").rows()[0][0]
+    single = DistributedQueryRunner(cat, worker_count=3)
+    expect = single.execute(
+        "select count(*), sum(l_quantity) from tpch.lineitem").rows()
+    assert n == expect[0][0]
+    assert d.execute(
+        "select count(*), sum(l_quantity) from li2").rows() == expect

@@ -17,6 +17,13 @@ __all__ = ["Catalog", "default_catalog"]
 class Catalog:
     def __init__(self):
         self._connectors: dict[str, Connector] = {}
+        # CREATE FUNCTION registry: name -> (params, return_type, body AST)
+        # (reference: metadata/GlobalFunctionCatalog for SQL routines)
+        self.sql_functions: dict[str, tuple] = {}
+        # polymorphic table functions: name -> spi.table_function.TableFunction
+        from ..spi.table_function import builtin_table_functions
+
+        self.table_functions: dict = builtin_table_functions()
 
     def register(self, name: str, connector: Connector) -> None:
         self._connectors[name] = connector

@@ -180,6 +180,39 @@ class MemoryConnector(Connector):
                     self._pinned_rows[table] += sum(
                         b.live_count for b in staged)
 
+    # ---- transactions ----------------------------------------------------
+    def begin_transaction(self):
+        """Snapshot handle: per-table batch-list lengths + the table set.
+        Rollback undoes INSERT/CTAS/CREATE TABLE performed since BEGIN by
+        truncating back to the snapshot (DELETE's drop-and-rewrite is not
+        transactional — mirrors the reference memory connector, which only
+        supports INSERT/CREATE in a transaction)."""
+        with self._lock:
+            return {
+                "tables": set(self._schemas),
+                "lengths": {t: len(b) for t, b in self._data.items()},
+            }
+
+    def commit_transaction(self, handle) -> None:
+        pass  # writes applied eagerly; commit just drops the snapshot
+
+    def rollback_transaction(self, handle) -> None:
+        if handle is None:
+            return
+        with self._lock:
+            for t in list(self._schemas):
+                if t not in handle["tables"]:
+                    self._schemas.pop(t, None)
+                    self._data.pop(t, None)
+                    self._pinned_rows.pop(t, None)
+            for t, n in handle["lengths"].items():
+                if t in self._data and len(self._data[t]) > n:
+                    removed = self._data[t][n:]
+                    del self._data[t][n:]
+                    if t in self._pinned_rows:
+                        self._pinned_rows[t] -= sum(
+                            b.live_count for b in removed)
+
     def pin_to_device(self, table: str) -> None:
         """Make a table device-resident: batches become bucket-padded jax
         arrays living in HBM, so scans hand columns straight to the jitted

@@ -38,10 +38,14 @@ def _valueset_mask(data: np.ndarray, vs: ValueSet) -> np.ndarray:
 
 
 def tuple_domain_mask(batch: ColumnBatch, constraint: TupleDomain,
-                      name_to_idx: dict[str, int]) -> Optional[np.ndarray]:
+                      name_to_idx: dict[str, int],
+                      dict_cache: Optional[dict] = None) -> Optional[np.ndarray]:
     """Boolean keep-mask for a host batch under ``constraint`` (None = keep
     all rows).  Dictionary columns evaluate the domain once per dictionary
-    entry and gather; plain columns evaluate on the storage array."""
+    entry and gather; ``dict_cache`` (caller-owned, keyed by (column,
+    id(dictionary))) memoizes those tables — batches of one table share a
+    dictionary, so the O(dict) python scan runs once per query, not per
+    batch."""
     if constraint.is_none:
         return np.zeros(batch.num_rows, dtype=bool)
     mask: Optional[np.ndarray] = None
@@ -52,9 +56,14 @@ def tuple_domain_mask(batch: ColumnBatch, constraint: TupleDomain,
         c = batch.columns[idx]
         data = np.asarray(c.data)
         if c.dictionary is not None:
-            tab = np.array(
-                [dom.values.contains_value(str(v)) for v in c.dictionary],
-                dtype=bool)
+            ck = (col, id(c.dictionary))
+            tab = dict_cache.get(ck) if dict_cache is not None else None
+            if tab is None:
+                tab = np.array(
+                    [dom.values.contains_value(str(v)) for v in c.dictionary],
+                    dtype=bool)
+                if dict_cache is not None:
+                    dict_cache[ck] = tab
             m = tab[data] if len(tab) else np.zeros(len(data), dtype=bool)
         else:
             m = _valueset_mask(data, dom.values)

@@ -173,29 +173,118 @@ def _sentinel(fn: str, dtype) -> object:
 
 @lru_cache(maxsize=None)
 def _reduce_fn(spec: tuple, cap: int):
-    """spec: tuple of (fn, has_valid, dtype_str, distinct) per aggregate;
-    inputs to the jitted fn: perm, gid, then per-agg (data [, valid])."""
+    """spec: tuple of (fn, data_idx, valid_idx, dtype_str, distinct, pre)
+    per aggregate; data_idx/valid_idx index the DEDUPED flat input arrays
+    (-1 = absent), so aggregates sharing a column or a validity/live mask
+    share one prefix scan.  ``pre`` applies elementwise prep INSIDE the
+    compiled program (("scale", s) = scale-free f64 avg state; ("square",) =
+    x^2 f64 variance state) — the hot path never runs eager full-size ops.
+
+    All reductions are prefix-scan + boundary-gather over the sorted rows
+    (gid is nondecreasing): XLA scatters serialize on TPU; the scan path is
+    log-depth vector work."""
 
     @jax.jit
     def fn(perm, gid, *flat):
         outs = []
-        i = 0
+        n = perm.shape[0]
         ones = jnp.ones(perm.shape, dtype=jnp.int64)
-        for fname, has_valid, dtype_str, distinct in spec:
+        starts = jnp.searchsorted(gid, jnp.arange(cap))
+        ends = jnp.concatenate([starts[1:], jnp.array([n], starts.dtype)])
+        nonempty = ends > starts
+        seg_first = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), gid[1:] != gid[:-1]])
+
+        sorted_cache: dict = {}
+
+        def sorted_of(idx):
+            if idx not in sorted_cache:
+                sorted_cache[idx] = flat[idx][perm]
+            return sorted_cache[idx]
+
+        # trace-time memo keyed by LOGICAL identity: aggregates sharing a
+        # (column, validity, dtype, prep) emit one scan, not one each — the
+        # TPU compiler segfaults on dozens of megarow cumsums in one fusion
+        _memo: dict = {}
+
+        def seg_sum_raw(x, acc_dtype, key=None):
+            mkey = None if key is None else ("raw",) + key
+            if mkey is not None and mkey in _memo:
+                return _memo[mkey]
+            cs = jnp.cumsum(x.astype(acc_dtype))
+            hi = cs[jnp.maximum(ends - 1, 0)]
+            lo = jnp.where(starts > 0, cs[jnp.maximum(starts - 1, 0)],
+                           jnp.zeros((), acc_dtype))
+            out = jnp.where(nonempty, hi - lo, jnp.zeros((), acc_dtype))
+            if mkey is not None:
+                _memo[mkey] = out
+            return out
+
+        def seg_sum(x, acc_dtype, ieee: bool, key=None):
+            if np.dtype(acc_dtype).kind != "f" or not ieee:
+                return seg_sum_raw(x, acc_dtype, key)
+            mkey = None if key is None else ("ieee",) + key
+            if mkey is not None and mkey in _memo:
+                return _memo[mkey]
+            # float path: a NaN/Inf anywhere would poison the global prefix
+            # sum for every LATER segment; zero them out and restore the
+            # IEEE result per segment
+            xa = x.astype(acc_dtype)
+            finite = jnp.isfinite(xa)
+            base = seg_sum_raw(jnp.where(finite, xa, 0.0), acc_dtype)
+            has_nan = seg_sum_raw(jnp.isnan(xa).astype(jnp.int32),
+                                  jnp.int32) > 0
+            has_pos = seg_sum_raw((xa == jnp.inf).astype(jnp.int32),
+                                  jnp.int32) > 0
+            has_neg = seg_sum_raw((xa == -jnp.inf).astype(jnp.int32),
+                                  jnp.int32) > 0
+            out = jnp.where(has_pos, jnp.inf, base)
+            out = jnp.where(has_neg, -jnp.inf, out)
+            out = jnp.where(has_nan | (has_pos & has_neg), jnp.nan, out)
+            out = out.astype(acc_dtype)
+            if mkey is not None:
+                _memo[mkey] = out
+            return out
+
+        def seg_minmax(x, is_min: bool):
+            op = jnp.minimum if is_min else jnp.maximum
+
+            def comb(a, b):
+                fa, va = a
+                fb, vb = b
+                return (fa | fb, jnp.where(fb, vb, op(va, vb)))
+
+            _, running = jax.lax.associative_scan(comb, (seg_first, x))
+            return running[jnp.maximum(ends - 1, 0)]
+
+        def seg_any(valid_idx):
+            v = sorted_of(valid_idx)
+            return seg_sum_raw(v.astype(jnp.int32), jnp.int32,
+                               ("any", valid_idx)) > 0
+
+        for fname, data_idx, valid_idx, dtype_str, distinct, pre in spec:
             dtype = jnp.dtype(dtype_str)
             if fname == "count_star":
-                c = ones
-                if has_valid:  # the live mask of a padded batch
-                    c = flat[i][perm].astype(jnp.int64)
-                    i += 1
-                outs.append((jax.ops.segment_sum(c, gid, cap), None))
+                if valid_idx >= 0:  # the live mask of a padded batch
+                    c = sorted_of(valid_idx).astype(jnp.int64)
+                    outs.append((seg_sum_raw(c, jnp.int64,
+                                             ("count", valid_idx)), None))
+                else:
+                    outs.append((seg_sum_raw(ones, jnp.int64,
+                                             ("count", -1)), None))
                 continue
-            data = flat[i][perm]
-            i += 1
-            valid = None
-            if has_valid:
-                valid = flat[i][perm]
-                i += 1
+            data = sorted_of(data_idx)
+            # integer-sourced values can never be NaN/Inf: their float sums
+            # skip the IEEE rescue scans entirely
+            src_float = np.dtype(data.dtype).kind == "f"
+            if pre is not None:
+                if pre[0] == "scale":
+                    data = data.astype(jnp.float64) / (10.0 ** pre[1])
+                elif pre[0] == "square":
+                    x64 = data.astype(jnp.float64)
+                    data = x64 * x64
+            valid = sorted_of(valid_idx) if valid_idx >= 0 else None
+            skey = (data_idx, valid_idx, np.dtype(dtype_str).str, pre)
             if distinct:
                 # rows sorted by group key only; distinct needs per-(group,
                 # value) dedup: mark first occurrence within (gid, valid,
@@ -217,54 +306,145 @@ def _reduce_fn(spec: tuple, cap: int):
                     first = first | jnp.concatenate(
                         [jnp.ones((1,), jnp.bool_), v2[1:] != v2[:-1]])
                 keep = first if v2 is None else (first & v2)
+                # d2/g2 reorder rows within each segment only: the segment
+                # boundary positions (starts/ends) are unchanged
                 if fname in ("count", "count_star"):
-                    outs.append((jax.ops.segment_sum(keep.astype(jnp.int64), g2, cap), None))
+                    outs.append((seg_sum_raw(keep.astype(jnp.int64),
+                                             jnp.int64), None))
                     continue
                 if fname == "sum":
                     x = jnp.where(keep, d2, jnp.zeros((), dtype))
-                    s = jax.ops.segment_sum(x.astype(dtype), g2, cap)
-                    anyv = jax.ops.segment_max(keep, g2, cap)
-                    outs.append((s, anyv))
+                    anyk = seg_sum_raw(keep.astype(jnp.int32), jnp.int32) > 0
+                    outs.append((seg_sum(x, dtype, src_float), anyk))
                     continue
                 raise NotImplementedError(f"distinct {fname}")
             if fname == "count":
-                c = ones if valid is None else valid.astype(jnp.int64)
-                outs.append((jax.ops.segment_sum(c, gid, cap), None))
+                if valid is None:
+                    outs.append((seg_sum_raw(ones, jnp.int64,
+                                             ("count", -1)), None))
+                else:
+                    outs.append((seg_sum_raw(valid.astype(jnp.int64),
+                                             jnp.int64,
+                                             ("count", valid_idx)), None))
             elif fname == "sum":
                 x = data if valid is None else jnp.where(valid, data, jnp.zeros((), data.dtype))
-                s = jax.ops.segment_sum(x.astype(dtype), gid, cap)
-                anyv = (
-                    None
-                    if valid is None
-                    else jax.ops.segment_max(valid, gid, cap)
-                )
+                s = seg_sum(x, dtype, src_float, ("sum",) + skey)
+                anyv = None if valid is None else seg_any(valid_idx)
                 outs.append((s, anyv))
             elif fname in ("min", "max"):
                 sent = _sentinel(fname, data.dtype)
                 x = data if valid is None else jnp.where(valid, data, sent)
-                red = jax.ops.segment_min if fname == "min" else jax.ops.segment_max
-                r = red(x, gid, cap)
-                anyv = (
-                    None
-                    if valid is None
-                    else jax.ops.segment_max(valid, gid, cap)
-                )
+                r = seg_minmax(x, fname == "min")
+                anyv = None if valid is None else seg_any(valid_idx)
                 outs.append((r, anyv))
             elif fname == "any_value":
-                # scatter only VALID rows (NULL lanes carry storage fill)
-                tgt = gid if valid is None else jnp.where(valid, gid, cap)
-                r = jnp.zeros((cap + 1,), data.dtype).at[tgt].set(data)[:cap]
-                anyv = (
-                    None
-                    if valid is None
-                    else jnp.zeros((cap,), jnp.bool_).at[gid].max(valid)
-                )
-                outs.append((r, anyv))
+                # gather at each segment's first VALID row: re-sort rows so
+                # invalid ones go last within their segment, then take starts
+                if valid is None:
+                    rows = jnp.minimum(starts, n - 1)
+                    outs.append((data[rows], None))
+                else:
+                    order = jnp.lexsort((~valid, gid))
+                    rows = jnp.minimum(starts, n - 1)
+                    outs.append((data[order][rows], seg_any(valid_idx)))
             else:
                 raise NotImplementedError(f"aggregate {fname}")
         return outs
 
     return fn
+
+
+@lru_cache(maxsize=None)
+def _finalize_fn(plan: tuple):
+    """One compiled program for aggregation finalization (avg division,
+    variance combine, output casts) over the tiny per-group arrays — the
+    output columns stay ON DEVICE (the collective exchange path feeds them
+    straight into all_to_all) and the host pays zero per-op dispatches.
+
+    plan: per output column, one of
+      ("copy", dtype_str|None, has_valid)            passthrough + cast
+      ("avg_final", dtype_str, has_valid)            sum/count -> mean
+      ("stat_final", fn, dtype_str, has_valid)       (s, sq, n) -> var/stddev
+      ("count", None, has_valid)                     cast int64, drop valid
+    inputs: flat (data [, valid]) per plan entry's source arity."""
+
+    @jax.jit
+    def fn(*flat):
+        outs = []
+        i = 0
+        for entry in plan:
+            kind = entry[0]
+            if kind == "copy":
+                _, dtype_str, has_valid = entry
+                d = flat[i]
+                i += 1
+                v = None
+                if has_valid:
+                    v = flat[i]
+                    i += 1
+                if dtype_str is not None:
+                    d = d.astype(jnp.dtype(dtype_str))
+                outs.append((d, v))
+            elif kind == "count":
+                _, _, has_valid = entry
+                d = flat[i]
+                i += 1
+                if has_valid:
+                    i += 1  # counts are never NULL
+                outs.append((d.astype(jnp.int64), None))
+            elif kind == "avg_final":
+                _, dtype_str, has_valid = entry
+                s = flat[i]
+                i += 1
+                sv = None
+                if has_valid:
+                    sv = flat[i]
+                    i += 1
+                c = flat[i]
+                i += 1
+                cnt = jnp.maximum(c, 1)
+                vals = s / cnt
+                valid = c > 0
+                if sv is not None:
+                    valid = valid & sv
+                outs.append((vals.astype(jnp.dtype(dtype_str)), valid))
+            elif kind == "stat_final":
+                _, fname, dtype_str, has_valid = entry
+                s = flat[i]
+                i += 1
+                sv = None
+                if has_valid:
+                    sv = flat[i]
+                    i += 1
+                q = flat[i]
+                i += 1
+                c = flat[i]
+                i += 1
+                n = c.astype(jnp.float64)
+                safe_n = jnp.maximum(n, 1.0)
+                mean = s / safe_n
+                m2 = jnp.maximum(q - safe_n * mean * mean, 0.0)
+                if fname in ("var_pop", "stddev_pop"):
+                    var = m2 / safe_n
+                    valid = n > 0
+                else:  # sample variance: NULL for fewer than 2 values
+                    var = m2 / jnp.maximum(n - 1.0, 1.0)
+                    valid = n > 1
+                vals = jnp.sqrt(var) if fname.startswith("stddev") else var
+                if sv is not None:
+                    valid = valid & sv
+                outs.append((vals.astype(jnp.dtype(dtype_str)), valid))
+            else:
+                raise NotImplementedError(kind)
+        return outs
+
+    return fn
+
+
+def finalize_groups(plan: Sequence[tuple], arrays: Sequence):
+    """Run the cached finalize program; ``arrays`` is the flat (device or
+    host) input list matching ``plan``."""
+    return _finalize_fn(tuple(plan))(*[jnp.asarray(a) for a in arrays])
 
 
 _PALLAS_STATE = {"enabled": None}
@@ -313,17 +493,31 @@ def grouped_reduce(
     num_groups: int,
     aggs: Sequence[tuple],
 ) -> list[tuple[np.ndarray, Optional[np.ndarray]]]:
-    """aggs: [(fn, data|None, valid|None, out_dtype, distinct), ...].
+    """aggs: [(fn, data|None, valid|None, out_dtype, distinct[, pre]), ...].
 
     Returns per-agg (values, valid|None) arrays of length num_groups.
-    """
+    Input arrays are DEDUPED by object identity before entering the jitted
+    program, so aggregates over the same column / live mask share scans."""
     cap = bucket(num_groups)
     results: list = [None] * len(aggs)
     spec = []
-    flat = []
+    flat: list = []
+    flat_ids: dict = {}
     xla_slots = []
-    for idx, (fn, data, valid, dtype, distinct) in enumerate(aggs):
-        if (fn == "sum" and data is not None and not distinct
+
+    def idx_of(arr) -> int:
+        if arr is None:
+            return -1
+        k = id(arr)
+        if k not in flat_ids:
+            flat_ids[k] = len(flat)
+            flat.append(jnp.asarray(arr))
+        return flat_ids[k]
+
+    for idx, entry in enumerate(aggs):
+        fn, data, valid, dtype, distinct = entry[:5]
+        pre = entry[5] if len(entry) > 5 else None
+        if (fn == "sum" and data is not None and not distinct and pre is None
                 and np.dtype(dtype) == np.float32 and cap <= 64
                 and _pallas_enabled()):
             out = _pallas_f32_sum(jnp.asarray(perm), jnp.asarray(gid), cap,
@@ -334,90 +528,179 @@ def grouped_reduce(
                                 else out[1][:num_groups])
                 continue
         if fn == "count_star" or data is None:
-            spec.append(("count_star", valid is not None, "int64", False))
-            if valid is not None:  # live mask: count only live rows
-                flat.append(jnp.asarray(valid))
+            spec.append(("count_star", -1, idx_of(valid), "int64", False,
+                         None))
             xla_slots.append(idx)
             continue
-        spec.append((fn, valid is not None, np.dtype(dtype).str, bool(distinct)))
-        flat.append(jnp.asarray(data))
-        if valid is not None:
-            flat.append(jnp.asarray(valid))
+        spec.append((fn, idx_of(data), idx_of(valid), np.dtype(dtype).str,
+                     bool(distinct), pre))
         xla_slots.append(idx)
-    if spec:
-        outs = _reduce_fn(tuple(spec), cap)(
-            jnp.asarray(perm), jnp.asarray(gid), *flat)
-        for idx, (data, valid) in zip(xla_slots, outs):
+
+    # the TPU compiler segfaults on programs mixing >=2 int64 prefix sums
+    # (x64 lanes are emulated) with a float64 prefix sum: split the specs
+    # into an integer-accumulator program and a float program
+    def _int_class(s) -> bool:
+        fn = s[0]
+        if fn in ("count", "count_star"):
+            return True
+        return fn == "sum" and np.dtype(s[3]).kind in "iu"
+
+    def _run(members) -> None:
+        """Run one compiled program for ``members``; on a TPU compiler
+        crash (flaky SIGSEGV on large mixed-dtype scan fusions) split the
+        program in half and retry — smaller programs always compile."""
+        # remap flat indices to the subset actually used by this program
+        sub_flat: list = []
+        remap: dict = {}
+
+        def sub_idx(fi: int) -> int:
+            if fi < 0:
+                return -1
+            if fi not in remap:
+                remap[fi] = len(sub_flat)
+                sub_flat.append(flat[fi])
+            return remap[fi]
+
+        sub_spec = tuple(
+            (s[0], sub_idx(s[1]), sub_idx(s[2]), s[3], s[4], s[5])
+            for _, s in members)
+        try:
+            outs = _reduce_fn(sub_spec, cap)(
+                jnp.asarray(perm), jnp.asarray(gid), *sub_flat)
+        except jax.errors.JaxRuntimeError:
+            # remote-compile crash (the TPU compiler helper segfaults on
+            # some large mixed-dtype scan fusions); genuine trace errors
+            # (NotImplementedError, dtype bugs) re-raise immediately
+            if len(members) == 1:
+                raise
+            mid = len(members) // 2
+            _run(members[:mid])
+            _run(members[mid:])
+            return
+        for (spec_i, _), (data, valid) in zip(members, outs):
+            idx = xla_slots[spec_i]
             results[idx] = (data[:num_groups],
                             None if valid is None else valid[:num_groups])
+
+    # the TPU compiler is unreliable on programs mixing several int64
+    # prefix sums (x64 lanes are emulated) with float64 prefix sums: run
+    # an integer-accumulator program and a float program, each with the
+    # split-retry ladder above
+    int_members = [(i, s) for i, s in enumerate(spec) if _int_class(s)]
+    flt_members = [(i, s) for i, s in enumerate(spec) if not _int_class(s)]
+    if int_members:
+        _run(int_members)
+    if flt_members:
+        _run(flt_members)
     return results
+
+
+@lru_cache(maxsize=None)
+def _keys_out_fn(has_valid: tuple, cap: int):
+    @jax.jit
+    def fn(perm, gid, *flat):
+        # gid is sorted: group g's representative is its FIRST sorted row —
+        # a binary-search gather, not a scatter (scatters serialize on TPU)
+        n = perm.shape[0]
+        starts = jnp.minimum(jnp.searchsorted(gid, jnp.arange(cap)), n - 1)
+        rows = perm[starts]
+        out = []
+        i = 0
+        for hv in has_valid:
+            d = flat[i][rows]
+            i += 1
+            if hv:
+                v = flat[i][rows]
+                i += 1
+                out.append((d, v))
+            else:
+                out.append((d, None))
+        return out
+
+    return fn
 
 
 def group_keys_out(perm, gid, num_groups: int, keys: Sequence[tuple]):
     """Materialize one representative key row per group (device arrays out;
-    dead rows carry gids >= cap-scatter range and are dropped)."""
+    dead rows carry gids >= cap-scatter range and are dropped).  One
+    compiled program per (key structure, cap) — no eager scatters."""
     cap = bucket(num_groups)
-    out = []
-    gid_j = jnp.asarray(gid)
-    perm_j = jnp.asarray(perm)
+    has_valid = tuple(v is not None for _, v in keys)
+    flat = []
     for data, valid in keys:
-        d = jnp.zeros((cap,), jnp.asarray(data).dtype).at[gid_j].set(
-            jnp.asarray(data)[perm_j], mode="drop")
-        out_d = d[:num_groups]
+        flat.append(jnp.asarray(data))
         if valid is not None:
-            v = jnp.zeros((cap,), jnp.bool_).at[gid_j].max(
-                jnp.asarray(valid)[perm_j], mode="drop")
-            out.append((out_d, v[:num_groups]))
-        else:
-            out.append((out_d, None))
-    return out
+            flat.append(jnp.asarray(valid))
+    outs = _keys_out_fn(has_valid, cap)(
+        jnp.asarray(perm), jnp.asarray(gid), *flat)
+    return [(d[:num_groups], None if v is None else v[:num_groups])
+            for d, v in outs]
 
 
 # ---------------------------------------------------------------------------
 # sort
 
 
-def sort_perm(keys: Sequence[tuple]) -> np.ndarray:
-    """keys: [(data, valid|None, ascending, nulls_first), ...] in major-to-
-    minor significance order.  Returns the stable sorting permutation.
+_HOST_SORT_MAX = 1 << 16  # below this, device dispatch latency dominates
 
-    Implemented as a single ``jnp.lexsort`` (XLA variadic sort)."""
+
+def _sort_columns(keys: Sequence[tuple], xp):
+    """Build lexsort columns (shared by host/device paths); ``xp`` is numpy
+    or jax.numpy."""
     sort_cols = []
     for data, valid, ascending, nulls_first in reversed(list(keys)):
-        d = jnp.asarray(data)
+        d = xp.asarray(data)
         kind = np.dtype(d.dtype).kind
         if not ascending:
             if kind == "b":
                 d = ~d
             elif kind == "f":
-                d = -d.astype(jnp.float64)
+                d = -d.astype(xp.float64)
             else:
                 # bitwise NOT is a bijective order reversal; unary minus maps
                 # INT64_MIN to itself under two's-complement wraparound
-                d = ~d.astype(jnp.int64)
+                d = ~d.astype(xp.int64)
         if valid is not None:
             # canonicalize NULL rows' payload FIRST (before NaN ranking):
             # two NULLs must tie exactly on every derived column, or their
             # garbage data would decide the less-significant keys
-            v = jnp.asarray(valid)
-            d = jnp.where(v, d, jnp.zeros((), d.dtype))
+            v = xp.asarray(valid)
+            d = xp.where(v, d, xp.zeros((), d.dtype))
         nan_rank = None
         if kind == "f":
             # NaN sorts largest (Trino convention) via its own rank column —
             # mapping NaN into the value domain (+/-inf) would tie with real
             # infinities; the rank is more significant than the value
-            nan = jnp.isnan(d)
-            nan_rank = jnp.where(nan, 1 if ascending else 0,
-                                 0 if ascending else 1)
-            d = jnp.where(nan, jnp.zeros((), d.dtype), d)
+            nan = xp.isnan(d)
+            nan_rank = xp.where(nan, 1 if ascending else 0,
+                                0 if ascending else 1)
+            d = xp.where(nan, xp.zeros((), d.dtype), d)
         sort_cols.append(d)
         if nan_rank is not None:
             sort_cols.append(nan_rank)
         if valid is not None:
             # secondary column is sorted after; null rank must be primary
-            null_rank = jnp.where(v, 1, 0) if nulls_first else jnp.where(v, 0, 1)
+            null_rank = xp.where(v, 1, 0) if nulls_first else xp.where(v, 0, 1)
             sort_cols.append(null_rank)
-    perm = jnp.lexsort(tuple(sort_cols))
+    return sort_cols
+
+
+def sort_perm(keys: Sequence[tuple]) -> np.ndarray:
+    """keys: [(data, valid|None, ascending, nulls_first), ...] in major-to-
+    minor significance order.  Returns the stable sorting permutation.
+
+    Large/device-resident inputs run as one ``jnp.lexsort`` (XLA variadic
+    sort on the chip).  Small host-resident inputs (the common post-
+    aggregation final sort: a handful of rows) run ``np.lexsort`` on host —
+    shipping 10 tiny columns through a tunneled device costs ~1000x the
+    sort itself."""
+    host = keys and all(
+        isinstance(k[0], np.ndarray)
+        and (k[1] is None or isinstance(k[1], np.ndarray))
+        for k in keys) and keys[0][0].shape[0] <= _HOST_SORT_MAX
+    if host:
+        return np.lexsort(tuple(_sort_columns(keys, np)))
+    perm = jnp.lexsort(tuple(_sort_columns(keys, jnp)))
     return np.asarray(perm)
 
 

@@ -42,6 +42,7 @@ from .operators import (
     ReplicateOperator,
     ScanOperator,
     SemiJoinOperator,
+    TableFunctionOperator,
     SortOperator,
     TableWriterOperator,
     TopNOperator,
@@ -245,6 +246,9 @@ class LocalPlanner:
             batch = _values_batch(node)
             return [ValuesOperator(batch)]
 
+        if isinstance(node, P.TableFunctionScan):
+            return [TableFunctionOperator(node.bound, node.output_names)]
+
         if isinstance(node, P.Output):
             chain = self._chain(node.source)
             chain.append(RenameOperator(node.output_names))
@@ -265,7 +269,12 @@ class LocalPlanner:
                 schema = TableSchema(node.table, tuple(
                     ColumnSchema(n, t) for n, t in
                     zip(node.source.output_names, node.source.output_types)))
-                conn.create_table(schema)
+                try:
+                    conn.create_table(schema)
+                except ValueError:
+                    # parallel writer tasks race to create the CTAS target;
+                    # first one wins (scaled writers)
+                    schema = conn.get_table_schema(node.table)
             # INSERT maps select output to table columns by POSITION
             chain.append(RenameOperator([c.name for c in schema.columns]))
             sink = conn.create_page_sink(node.table)

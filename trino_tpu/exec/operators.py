@@ -56,6 +56,7 @@ __all__ = [
     "LimitOperator",
     "GroupIdOperator",
     "ReplicateOperator",
+    "TableFunctionOperator",
     "UnnestOperator",
     "DistinctLimitOperator",
     "TableWriterOperator",
@@ -112,6 +113,7 @@ class ScanOperator(Operator):
         self.constraint = constraint if (
             constraint is not None and not constraint.is_all) else None
         self._name_to_idx = {n: i for i, n in enumerate(self.columns)}
+        self._domain_dict_cache: dict = {}
         self.rows_pruned_by_domain = 0
         self._source = None
         self.input_done = True
@@ -122,7 +124,8 @@ class ScanOperator(Operator):
     def _apply_constraint(self, batch: ColumnBatch) -> ColumnBatch:
         from .domain_filter import tuple_domain_mask
 
-        mask = tuple_domain_mask(batch, self.constraint, self._name_to_idx)
+        mask = tuple_domain_mask(batch, self.constraint, self._name_to_idx,
+                                 self._domain_dict_cache)
         if mask is None or mask.all():
             return batch
         self.rows_pruned_by_domain += int(batch.num_rows - mask.sum())
@@ -181,6 +184,33 @@ class ScanOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._closed or (self._source is None and not self.splits)
+
+
+class TableFunctionOperator(Operator):
+    """Leaf table-function source (reference:
+    operator/LeafTableFunctionOperator.java:41): drains the bound
+    function's batch generator."""
+
+    def __init__(self, bound, output_names):
+        self.output_names = list(output_names)
+        self._iter = bound.batches()
+        self._done = False
+        self.input_done = True
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        if self._done or self._closed:
+            return None
+        batch = next(self._iter, None)
+        if batch is None:
+            self._done = True
+            return None
+        return pad_to_bucket(batch.rename(self.output_names))
+
+    def is_finished(self) -> bool:
+        return self._done or self._closed
 
 
 class ValuesOperator(Operator):
@@ -712,41 +742,62 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                 scale = 0
                 if a.arg >= 0 and isinstance(inp.columns[a.arg].type, DecimalType):
                     scale = inp.columns[a.arg].type.scale
-                sum_data = s[1].astype(np.float64) / (10 ** scale)
-                specs.append(("sum", sum_data, s[2], np.float64, s[4]))
+                # scale-free f64 sum state; the division happens INSIDE the
+                # compiled reduce program (pre tag), never as an eager
+                # full-size op on the dispatch-latency-bound tunnel path
+                specs.append(("sum", s[1], s[2], np.float64, s[4],
+                              ("scale", scale)))
                 specs.append(("count", s[1], s[2], np.int64, s[4]))
             elif s[0] in STAT_AGGS:
                 stat_slots[idx] = len(specs)
-                x = s[1].astype(np.float64)
-                specs.append(("sum", x, s[2], np.float64, False))
-                specs.append(("sum", x * x, s[2], np.float64, False))
+                specs.append(("sum", s[1], s[2], np.float64, False))
+                specs.append(("sum", s[1], s[2], np.float64, False,
+                              ("square",)))
                 specs.append(("count", s[1], s[2], np.int64, False))
             else:
                 specs.append(s)
         reduced = K.grouped_reduce(perm, gid, num_groups, specs) if specs else []
 
-        out_cols: list[Column] = []
+        # finalization (avg division, variance combine, output casts) runs
+        # as ONE compiled program over the tiny per-group arrays: zero eager
+        # dispatches, and the output columns STAY ON DEVICE so the
+        # collective exchange path can feed them straight into all_to_all
+        plan: list[tuple] = []
+        arrays: list = []
+        col_types: list = []
+        col_dicts: list = []
+
+        def emit(entry, srcs, t, dict_=None):
+            plan.append(entry)
+            arrays.extend(srcs)
+            col_types.append(t)
+            col_dicts.append(dict_)
+
         for (d, v), c in zip(keys_out, key_cols):
-            out_cols.append(Column(c.type, d, v, c.dictionary))
+            emit(("copy", None, v is not None),
+                 [d] + ([v] if v is not None else []), c.type, c.dictionary)
         ri = 0
+        ncols = nk
         for idx, a in enumerate(self.aggs):
-            t = self.output_types[len(out_cols)]
+            t = self.output_types[ncols]
             if idx in avg_slots:
                 s_data, s_valid = reduced[ri]
                 c_data, _ = reduced[ri + 1]
                 ri += 2
                 if self.step == "PARTIAL":
                     # emit mergeable states: scale-free sum + count
-                    out_cols.append(Column(t, s_data.astype(np.float64), s_valid))
-                    out_cols.append(Column(self.output_types[len(out_cols)],
-                                           c_data.astype(np.int64)))
+                    emit(("copy", "<f8", s_valid is not None),
+                         [s_data] + ([s_valid] if s_valid is not None else []),
+                         t)
+                    emit(("count", None, False), [c_data],
+                         self.output_types[ncols + 1])
+                    ncols += 2
                     continue
-                cnt = jnp.maximum(jnp.asarray(c_data), 1)
-                vals = jnp.asarray(s_data) / cnt
-                valid = jnp.asarray(c_data) > 0
-                if s_valid is not None:
-                    valid = valid & jnp.asarray(s_valid)
-                out_cols.append(Column(t, vals.astype(t.storage_dtype), valid))
+                emit(("avg_final", np.dtype(t.storage_dtype).str,
+                      s_valid is not None),
+                     [s_data] + ([s_valid] if s_valid is not None else [])
+                     + [c_data], t)
+                ncols += 1
                 continue
             if idx in stat_slots:
                 # variance family: combine (sum, sumsq, count) states
@@ -756,26 +807,20 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                 c_data, _ = reduced[ri + 2]
                 ri += 3
                 if self.step == "PARTIAL":
-                    out_cols.append(Column(t, s_data.astype(np.float64), s_valid))
-                    out_cols.append(Column(self.output_types[len(out_cols)],
-                                           q_data.astype(np.float64)))
-                    out_cols.append(Column(self.output_types[len(out_cols)],
-                                           c_data.astype(np.int64)))
+                    emit(("copy", "<f8", s_valid is not None),
+                         [s_data] + ([s_valid] if s_valid is not None else []),
+                         t)
+                    emit(("copy", "<f8", False), [q_data],
+                         self.output_types[ncols + 1])
+                    emit(("count", None, False), [c_data],
+                         self.output_types[ncols + 2])
+                    ncols += 3
                     continue
-                n = jnp.asarray(c_data).astype(jnp.float64)
-                safe_n = jnp.maximum(n, 1.0)
-                mean = jnp.asarray(s_data) / safe_n
-                m2 = jnp.maximum(jnp.asarray(q_data) - safe_n * mean * mean, 0.0)
-                if a.fn in ("var_pop", "stddev_pop"):
-                    var = m2 / safe_n
-                    valid = n > 0
-                else:  # sample variance: NULL for fewer than 2 values
-                    var = m2 / jnp.maximum(n - 1.0, 1.0)
-                    valid = n > 1
-                vals = jnp.sqrt(var) if a.fn.startswith("stddev") else var
-                if s_valid is not None:
-                    valid = valid & jnp.asarray(s_valid)
-                out_cols.append(Column(t, vals.astype(t.storage_dtype), valid))
+                emit(("stat_final", a.fn, np.dtype(t.storage_dtype).str,
+                      s_valid is not None),
+                     [s_data] + ([s_valid] if s_valid is not None else [])
+                     + [q_data, c_data], t)
+                ncols += 1
                 continue
             d, v = reduced[ri]
             ri += 1
@@ -786,7 +831,12 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                 dict_ = inp.columns[a.arg].dictionary
             elif self.step == "FINAL" and a.fn in ("min", "max", "any_value"):
                 dict_ = inp.columns[a.arg].dictionary
-            out_cols.append(Column(t, d.astype(t.storage_dtype), v, dict_))
+            emit(("copy", np.dtype(t.storage_dtype).str, v is not None),
+                 [d] + ([v] if v is not None else []), t, dict_)
+            ncols += 1
+        outs = K.finalize_groups(plan, arrays)
+        out_cols = [Column(t, d, v, dc)
+                    for (d, v), t, dc in zip(outs, col_types, col_dicts)]
         return ColumnBatch(self.output_names, out_cols)
 
     def get_output(self) -> Optional[ColumnBatch]:

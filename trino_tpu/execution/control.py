@@ -150,10 +150,11 @@ class ResourceGroup:
         self._lock = parent._lock if parent is not None else threading.Lock()
 
     def subgroup(self, name: str, **kwargs) -> "ResourceGroup":
-        if name not in self.children:
-            self.children[name] = ResourceGroup(
-                f"{self.name}.{name}", parent=self, **kwargs)
-        return self.children[name]
+        with self._lock:  # _dispatch_queued iterates children under the lock
+            if name not in self.children:
+                self.children[name] = ResourceGroup(
+                    f"{self.name}.{name}", parent=self, **kwargs)
+            return self.children[name]
 
     def _can_run(self) -> bool:
         g: Optional[ResourceGroup] = self
@@ -317,6 +318,14 @@ class NodeManager:
             else:
                 info.last_heartbeat = time.monotonic()
 
+    def heartbeat(self, node_id: str) -> None:
+        """Refresh liveness of an EXISTING node only — a heartbeat must not
+        resurrect a node removed by the operator (remove() is deliberate)."""
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is not None:
+                info.last_heartbeat = time.monotonic()
+
     def drain(self, node_id: str) -> None:
         """Graceful shutdown: stop placing new tasks on the node
         (reference: server/GracefulShutdownHandler.java:42)."""
@@ -361,6 +370,11 @@ class HeartbeatFailureDetector:
         with self._lock:
             self._pingers[node_id] = ping
 
+    def unmonitor(self, node_id: str) -> None:
+        with self._lock:
+            self._pingers.pop(node_id, None)
+            self._failed.discard(node_id)
+
     def start(self) -> None:
         if self._thread is not None:
             return
@@ -388,7 +402,9 @@ class HeartbeatFailureDetector:
             except BaseException:
                 ok = False
             if ok:
-                self.nodes.announce(node_id)
+                # heartbeat (not announce): a ping must never resurrect a
+                # node the operator removed from membership
+                self.nodes.heartbeat(node_id)
                 with self._lock:
                     self._failed.discard(node_id)
             else:

@@ -81,7 +81,11 @@ class DistributedQueryRunner:
     def _plan_stmt(self, stmt: ast.Statement) -> PlanNode:
         plan = LogicalPlanner(self.catalog, self.session.default_catalog).plan(stmt)
         plan = optimize(plan, self.catalog)
-        return add_exchanges(plan)
+        writer_tasks = 1
+        if self.session.scale_writers:
+            writer_tasks = max(1, min(self.session.writer_task_limit,
+                                      self.worker_count))
+        return add_exchanges(plan, writer_tasks=writer_tasks)
 
     def create_subplan(self, sql: str) -> SubPlan:
         return fragment_plan(self.create_plan(sql))
@@ -92,6 +96,11 @@ class DistributedQueryRunner:
     # --------------------------------------------------------------- execute
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        from .transaction import handle_transaction_stmt
+
+        txn = handle_transaction_stmt(stmt, self.session, self.catalog)
+        if txn is not None:
+            return txn
         if isinstance(stmt, ast.Explain):
             subplan = fragment_plan(self._plan_stmt(stmt.statement))
             lines = subplan.text().splitlines()
@@ -238,10 +247,16 @@ class DistributedQueryRunner:
         output-buffer partition count of a fragment is its consumer's task
         count (the root's consumer is the client: 1)."""
         workers = self.active_worker_count
-        task_counts = {
-            f.id: (1 if f.partitioning == "SINGLE" else workers)
-            for f in fragments
-        }
+        writer_cap = max(1, min(self.session.writer_task_limit, workers))
+        task_counts = {}
+        for f in fragments:
+            if f.partitioning == "SINGLE":
+                task_counts[f.id] = 1
+            elif f.partitioning == "ARBITRARY":
+                # scaled-writer fragments honor the configured writer limit
+                task_counts[f.id] = writer_cap
+            else:
+                task_counts[f.id] = workers
         consumer_tasks: dict[int, int] = {}
         for f in fragments:
             for src in f.source_fragments:

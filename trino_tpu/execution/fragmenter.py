@@ -117,6 +117,8 @@ class _Fragmenter:
             return "SOURCE"
         if "REPARTITION" in kinds:
             return "HASH"
+        if "ROUND_ROBIN" in kinds:
+            return "ARBITRARY"  # FIXED_ARBITRARY_DISTRIBUTION: multi-task
         return "SINGLE"
 
 

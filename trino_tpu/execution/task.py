@@ -239,6 +239,7 @@ class PartitionedOutputSink(Operator):
         self.kind = kind
         self.keys = list(keys)
         self.serde = serde  # serialize pages to wire bytes (network mode)
+        self._rr = 0  # ROUND_ROBIN rotation cursor
 
     def _page(self, batch: ColumnBatch):
         if self.serde:
@@ -263,6 +264,11 @@ class PartitionedOutputSink(Operator):
             page = self._page(batch)
             for p in range(n):
                 self.buffer.enqueue(p, page)
+        elif self.kind == "ROUND_ROBIN" and n > 1:
+            # batch-granular rotation (RandomExchanger / ArbitraryOutputBuffer
+            # role: balance load without any key)
+            self.buffer.enqueue(self._rr % n, self._page(batch))
+            self._rr += 1
         else:
             self.buffer.enqueue(0, self._page(batch))
 

@@ -42,6 +42,7 @@ from .plan import (
     Replicate,
     SemiJoin,
     Sort,
+    TableFunctionScan,
     TableScan,
     TableWriter,
     TopN,
@@ -75,8 +76,12 @@ def partial_agg_layout(aggs, input_types) -> list[tuple[str, Type, int]]:
     return out
 
 
-def add_exchanges(root: PlanNode) -> PlanNode:
-    return _visit(root, single=True)
+def add_exchanges(root: PlanNode, writer_tasks: int = 1) -> PlanNode:
+    """``writer_tasks > 1`` plans INSERT/CTAS with parallel writers fed by a
+    ROUND_ROBIN exchange (the SCALED_WRITER_* partitionings planned by
+    estimate; SkewedPartitionRebalancer-style runtime growth is a later
+    round — see SystemPartitioningHandle.java:48-57)."""
+    return _visit(root, single=True, writer_tasks=writer_tasks)
 
 
 def _exchange(node: PlanNode, kind: str, keys=()) -> Exchange:
@@ -84,9 +89,26 @@ def _exchange(node: PlanNode, kind: str, keys=()) -> Exchange:
                     "REMOTE", tuple(keys))
 
 
-def _visit(node: PlanNode, single: bool) -> PlanNode:
+def _visit(node: PlanNode, single: bool, writer_tasks: int = 1) -> PlanNode:
     """Rewrite bottom-up.  ``single`` = the parent requires this subtree's
     output to arrive at one task (root stage)."""
+
+    if isinstance(node, TableWriter) and writer_tasks > 1:
+        from dataclasses import replace as _replace
+
+        src = _visit(node.source, single=False)
+        rr = Exchange(src.output_names, src.output_types, src,
+                      "ROUND_ROBIN", "REMOTE", ())
+        # writer tasks each emit one BIGINT row count ("rows"); note the
+        # optimizer's generic remap leaves TableWriter.output_names pointing
+        # at the SOURCE columns, so the writer contract is restated here
+        writer = _replace(node, source=rr,
+                          output_names=("rows",), output_types=(BIGINT,))
+        gathered = _exchange(writer, "GATHER")
+        # TableFinish: sum the per-writer row counts
+        # (reference: operator/TableFinishOperator.java:51)
+        return Aggregate(("rows",), (BIGINT,), gathered,
+                         (), (AggCall("sum", 0, BIGINT),))
 
     if isinstance(node, Aggregate):
         return _split_aggregate(node, single)
@@ -161,7 +183,11 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
         return DistinctLimit(node.output_names, node.output_types, gathered,
                              node.count)
 
-    if isinstance(node, (Output, TableWriter)):
+    if isinstance(node, Output):
+        src = _visit(node.source, single=True, writer_tasks=writer_tasks)
+        return _replace_source(node, src)
+
+    if isinstance(node, TableWriter):
         src = _visit(node.source, single=True)
         return _replace_source(node, src)
 
@@ -185,7 +211,7 @@ def _visit(node: PlanNode, single: bool) -> PlanNode:
             srcs.append(v)
         return _gather_if(_replace(node, sources=tuple(srcs)), single)
 
-    if isinstance(node, (TableScan, Values)):
+    if isinstance(node, (TableScan, Values, TableFunctionScan)):
         return _gather_if(node, single)
 
     if isinstance(node, Exchange):  # already placed (LOCAL exchanges later)

@@ -48,6 +48,7 @@ from .plan import (
     SemiJoin,
     Sort,
     SortKey,
+    TableFunctionScan,
     TableScan,
     TableWriter,
     TopN,
@@ -135,6 +136,9 @@ class LogicalPlanner:
 
     # ------------------------------------------------------------------ api
     def plan(self, stmt: ast.Statement) -> PlanNode:
+        from ..sql.analyzer import SQL_FUNCTIONS
+
+        SQL_FUNCTIONS.set(getattr(self.catalog, "sql_functions", {}))
         if isinstance(stmt, ast.QueryStatement):
             rel = self.plan_query(stmt.query, None, {})
             return Output(self.node_names(rel), rel.node.output_types, rel.node)
@@ -894,11 +898,44 @@ class LogicalPlanner:
                         f"but relation has {rel.width} columns")
                 node = replace(node, output_names=tuple(r.column_names))
             return RelationPlan(node, [r.alias] * rel.width)
+        if isinstance(r, ast.TableFunctionRelation):
+            return self._plan_table_function(r, outer)
         if isinstance(r, ast.UnnestRelation):
             return self._plan_unnest(None, r, outer, ctes)
         if isinstance(r, ast.Join):
             return self.plan_join(r, outer, ctes)
         raise AnalysisError(f"unsupported relation: {type(r).__name__}")
+
+    def _plan_table_function(self, r: ast.TableFunctionRelation,
+                             outer) -> RelationPlan:
+        """TABLE(fn(args)): bind constant arguments, fix the schema
+        (reference: ConnectorTableFunction.analyze -> TableFunctionAnalysis)."""
+        fn = self.catalog.table_functions.get(r.name)
+        if fn is None:
+            raise AnalysisError(f"table function not registered: {r.name}")
+        dummy = RelationPlan(
+            Values(("_row",), (BIGINT,), rows=((0,),)), [None])
+        tr = Translator(dummy.scope(outer))
+        arg_vals = []
+        for a in r.args:
+            ir = tr.translate(a)
+            if not isinstance(ir, Literal):
+                raise AnalysisError(
+                    f"table function {r.name} arguments must be constants")
+            arg_vals.append(ir.value)
+        try:
+            bound = fn.bind(arg_vals)
+        except ValueError as e:
+            raise AnalysisError(str(e))
+        names = tuple(bound.names)
+        if r.column_names is not None:
+            if len(r.column_names) != len(names):
+                raise AnalysisError(
+                    f"column alias list has {len(r.column_names)} names "
+                    f"but {r.name} produces {len(names)} columns")
+            names = tuple(r.column_names)
+        node = TableFunctionScan(names, tuple(bound.types), r.name, bound)
+        return RelationPlan(node, [r.alias] * len(names))
 
     def _plan_unnest(self, left: Optional[RelationPlan],
                      u: ast.UnnestRelation, outer, ctes) -> RelationPlan:

@@ -37,6 +37,7 @@ from .plan import (
     Replicate,
     SemiJoin,
     Sort,
+    TableFunctionScan,
     TableScan,
     TableWriter,
     TopN,
@@ -357,7 +358,7 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
             new_sources.append(child)
         return replace(node, sources=tuple(new_sources)), _identity(node)
 
-    if isinstance(node, (TableScan, Values)):
+    if isinstance(node, (TableScan, Values, TableFunctionScan)):
         return node, _identity(node)
 
     raise NotImplementedError(f"optimizer: {type(node).__name__}")
@@ -677,7 +678,7 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
                         tuple(node.columns[i] for i in kept))
         return out, key_mapping(kept, len(node.output_types))
 
-    if isinstance(node, Values):
+    if isinstance(node, (Values, TableFunctionScan)):
         return node, list(range(len(node.output_types)))
 
     if isinstance(node, Aggregate):

@@ -20,7 +20,7 @@ from ..sql.ir import RowExpression
 
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
-    "GroupId", "Unnest",
+    "GroupId", "Unnest", "TableFunctionScan",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
     "Window", "WindowFunc", "Union", "Replicate", "plan_text",
@@ -351,6 +351,20 @@ class Values(PlanNode):
 
     def label(self) -> str:
         return f"Values[{len(self.rows)} rows]"
+
+
+@dataclass(frozen=True)
+class TableFunctionScan(PlanNode):
+    """Leaf table-function invocation (reference: sql/planner/plan/
+    TableFunctionNode.java executed by LeafTableFunctionOperator.java:41).
+    ``bound`` is an spi.table_function.BoundTableFunction — excluded from
+    eq/hash (it closes over a generator factory)."""
+
+    name: str = ""
+    bound: object = field(default=None, compare=False)
+
+    def label(self) -> str:
+        return f"TableFunctionScan[{self.name}]"
 
 
 @dataclass(frozen=True)

@@ -49,6 +49,19 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
     from .spi.connector import ColumnSchema, TableSchema
     from .spi.types import parse_type
 
+    if isinstance(stmt, ast.CreateFunction):
+        from .sql.analyzer import is_builtin_function
+
+        if is_builtin_function(stmt.name):
+            raise ValueError(
+                f"cannot create function {stmt.name!r}: shadows a builtin")
+        catalog.sql_functions[stmt.name.lower()] = (
+            stmt.params, stmt.return_type, stmt.body)
+        return count_result("rows", 0)
+    if isinstance(stmt, ast.DropFunction):
+        if catalog.sql_functions.pop(stmt.name.lower(), None) is None:
+            raise KeyError(f"no such function: {stmt.name}")
+        return count_result("rows", 0)
     if isinstance(stmt, ast.CreateTable):
         cat, table = _split_name(stmt.table, default_catalog_name)
         conn = catalog.connector(cat)
@@ -168,6 +181,13 @@ class Session:
     # reference: execution/resourcegroups/InternalResourceGroup.java:75)
     query_concurrency: int = 16
     query_max_queued: int = 200
+    # active transaction (execution/transaction.py); None = autocommit
+    transaction: object = None
+    _transaction_manager: object = None
+    # INSERT/CTAS fan out over round-robin writer tasks when the source is
+    # large (SCALED_WRITER_* partitionings in miniature; planned by estimate)
+    scale_writers: bool = False
+    writer_task_limit: int = 4
 
 
 class StandaloneQueryRunner:
@@ -189,6 +209,11 @@ class StandaloneQueryRunner:
 
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        from .execution.transaction import handle_transaction_stmt
+
+        txn = handle_transaction_stmt(stmt, self.session, self.catalog)
+        if txn is not None:
+            return txn
         if isinstance(stmt, ast.Explain):
             return self._execute_explain(stmt)
         if isinstance(stmt, ast.ShowTables):

@@ -140,6 +140,19 @@ class Connector:
         dynamicFilter/TupleDomain it can use for pruning)."""
         raise NotImplementedError
 
+    # --- transactions -----------------------------------------------------
+    def begin_transaction(self):
+        """Open a connector-private transaction handle (mirrors
+        Connector.beginTransaction -> ConnectorTransactionHandle).  Default:
+        autocommit-only connectors return None."""
+        return None
+
+    def commit_transaction(self, handle) -> None:
+        pass
+
+    def rollback_transaction(self, handle) -> None:
+        pass
+
     # --- writes -----------------------------------------------------------
     def create_table(self, schema: TableSchema) -> None:
         raise NotImplementedError("connector does not support CREATE TABLE")

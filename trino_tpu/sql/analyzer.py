@@ -16,6 +16,8 @@ Type rules (intentional, documented divergences from Trino):
 
 from __future__ import annotations
 
+import contextvars
+import dataclasses
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
@@ -89,6 +91,23 @@ _SCALAR_TYPES: dict[str, str] = {
     "asin": "double", "acos": "double", "atan": "double", "atan2": "double",
     "log2": "double", "pi": "double", "e": "double",
 }
+
+
+# names with bespoke translation rules (not in _SCALAR_TYPES but built in)
+_SPECIAL_FUNCTIONS = {
+    "coalesce", "if", "mod", "nullif", "grouping", "greatest", "least",
+    "sign", "date_trunc", "cardinality", "element_at", "contains",
+    "array_position", "approx_distinct", "count_if", "geometric_mean",
+}
+
+
+def is_builtin_function(name: str) -> bool:
+    """CREATE FUNCTION must not shadow engine builtins (the reference's
+    LanguageFunctionManager rejects redefining global-catalog names)."""
+    n = name.lower()
+    return (n in _SCALAR_TYPES or n in AGG_FUNCTIONS or n in _AGG_ALIASES
+            or n in STAT_AGGS or n in WINDOW_FUNCTIONS
+            or n in _SPECIAL_FUNCTIONS)
 
 
 @dataclass(frozen=True)
@@ -233,6 +252,44 @@ def rewrite_expr(e: RowExpression, mapping: dict[RowExpression, RowExpression]) 
     return e
 
 
+# CREATE FUNCTION registry for the current planning thread: name ->
+# (params, return_type_str, body AST).  Set by LogicalPlanner.plan from
+# catalog.sql_functions (reference: metadata/GlobalFunctionCatalog +
+# LanguageFunctionManager resolving SQL routines during analysis)
+SQL_FUNCTIONS: contextvars.ContextVar[dict] = contextvars.ContextVar(
+    "sql_functions", default={})
+
+
+def _subst_params(e: ast.Expr, binding: dict[str, ast.Expr]) -> ast.Expr:
+    """Replace unqualified ColumnRefs naming a parameter with the bound
+    argument AST, recursively over the (frozen dataclass) expression tree."""
+    if isinstance(e, ast.ColumnRef):
+        if len(e.parts) == 1 and e.parts[0].lower() in binding:
+            return binding[e.parts[0].lower()]
+        return e
+    if not dataclasses.is_dataclass(e):
+        return e
+    changes = {}
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, ast.Expr):
+            nv = _subst_params(v, binding)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple):
+            nv = tuple(
+                _subst_params(x, binding) if isinstance(x, ast.Expr)
+                else (dataclasses.replace(
+                    x, **{g.name: _subst_params(getattr(x, g.name), binding)
+                          for g in dataclasses.fields(x)
+                          if isinstance(getattr(x, g.name), ast.Expr)})
+                      if dataclasses.is_dataclass(x) else x)
+                for x in v)
+            if nv != v:
+                changes[f.name] = nv
+    return dataclasses.replace(e, **changes) if changes else e
+
+
 class Translator:
     """AST expression -> typed IR over a scope.
 
@@ -252,6 +309,7 @@ class Translator:
         self.aggregates = aggregates
         self.subquery_cb = subquery_cb
         self.windows = windows
+        self._routine_stack: set[str] = set()
 
     # -- entry -------------------------------------------------------------
     def translate(self, e: ast.Expr) -> RowExpression:
@@ -650,6 +708,9 @@ class Translator:
                             (a, cast_to(b, BIGINT)))
             out_t = BOOLEAN if name == "contains" else BIGINT
             return Call(out_t, name, (a, b))
+        udf = SQL_FUNCTIONS.get().get(name)
+        if udf is not None:
+            return self._t_sql_routine(name, udf, e.args)
         if name not in _SCALAR_TYPES:
             raise AnalysisError(f"function not registered: {name}")
         args = tuple(self.translate(a) for a in e.args)
@@ -666,6 +727,27 @@ class Translator:
         else:
             out_t = VARCHAR
         return Call(out_t, name, args)
+
+    def _t_sql_routine(self, name: str, udf, arg_asts) -> RowExpression:
+        """Inline a CREATE FUNCTION body: substitute parameter references
+        with the (type-cast) argument ASTs, then translate in the calling
+        scope (reference: sql/routine/SqlRoutinePlanner inlining scalar
+        RETURN bodies; recursion is rejected like the reference's analyzer)."""
+        params, return_type, body = udf
+        if len(arg_asts) != len(params):
+            raise AnalysisError(
+                f"{name} expects {len(params)} arguments, got {len(arg_asts)}")
+        if name in self._routine_stack:
+            raise AnalysisError(f"recursive SQL function: {name}")
+        binding = {
+            pname.lower(): ast.Cast(a, ptype)
+            for (pname, ptype), a in zip(params, arg_asts)}
+        inlined = ast.Cast(_subst_params(body, binding), return_type)
+        self._routine_stack.add(name)
+        try:
+            return self.translate(inlined)
+        finally:
+            self._routine_stack.discard(name)
 
     # -- window calls ------------------------------------------------------
     def _const_int(self, e: ast.Expr, what: str) -> int:

@@ -250,6 +250,17 @@ class SubqueryRelation(Relation):
 
 
 @dataclass(frozen=True)
+class TableFunctionRelation(Relation):
+    """TABLE(fn(args...)) (reference: spi/function/table/
+    ConnectorTableFunction.java; executed by LeafTableFunctionOperator)."""
+
+    name: str
+    args: tuple[Expr, ...]
+    alias: Optional[str] = None
+    column_names: Optional[tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
 class UnnestRelation(Relation):
     """UNNEST(arr, ...) [WITH ORDINALITY] (reference: sql/tree/Unnest.java;
     planned as UnnestNode, executed by operator/unnest/UnnestOperator.java:42).
@@ -411,6 +422,37 @@ class Delete(Statement):
 class InsertInto(Statement):
     table: str
     query: Query
+
+
+@dataclass(frozen=True)
+class StartTransaction(Statement):
+    """START TRANSACTION / BEGIN (reference: sql/tree/StartTransaction.java)."""
+
+
+@dataclass(frozen=True)
+class Commit(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class Rollback(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class CreateFunction(Statement):
+    """CREATE FUNCTION with a scalar RETURN-expression body (reference:
+    sql/routine/SqlRoutineAnalyzer — the inlineable subset)."""
+
+    name: str
+    params: tuple[tuple[str, str], ...]  # (name, type string)
+    return_type: str
+    body: Expr
+
+
+@dataclass(frozen=True)
+class DropFunction(Statement):
+    name: str
 
 
 @dataclass(frozen=True)

@@ -162,7 +162,21 @@ class _Parser:
             return ast.Explain(inner, analyze=analyze)
         if self.peek_kw("select", "with") or self.peek_op("("):
             return ast.QueryStatement(self.parse_query())
+        if self.accept_word("start"):
+            self.expect_word("transaction")
+            return ast.StartTransaction()
+        if self.accept_word("begin"):
+            return ast.StartTransaction()
+        if self.accept_word("commit"):
+            return ast.Commit()
+        if self.accept_word("rollback"):
+            return ast.Rollback()
+        if self.accept_kw("values"):
+            self.i -= 1  # top-level VALUES statement
+            return ast.QueryStatement(self.parse_query())
         if self.accept_kw("create"):
+            if self.accept_word("function"):
+                return self._parse_create_function()
             self.expect_kw("table")
             name = self.qualified_name()
             if self.accept_op("("):
@@ -178,6 +192,8 @@ class _Parser:
             self.expect_kw("as")
             return ast.CreateTableAsSelect(name, self.parse_query())
         if self.accept_kw("drop"):
+            if self.accept_word("function"):
+                return ast.DropFunction(self.qualified_name())
             self.expect_kw("table")
             if_exists = False
             save = self.i
@@ -206,6 +222,27 @@ class _Parser:
         if self.accept_kw("describe"):
             return ast.ShowColumns(self.qualified_name())
         self.fail("expected statement")
+
+    def _parse_create_function(self) -> ast.Statement:
+        """CREATE FUNCTION f(x bigint, ...) RETURNS type RETURN expr
+        (reference: sql/routine — SqlRoutineAnalyzer; scalar RETURN-expression
+        bodies, the common inlineable case)."""
+        name = self.qualified_name()
+        params: list[tuple[str, str]] = []
+        self.expect_op("(")
+        if not self.peek_op(")"):
+            while True:
+                pname = self.expect_ident()
+                ptype = self.parse_type_name()
+                params.append((pname, ptype))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        self.expect_word("returns")
+        rtype = self.parse_type_name()
+        self.expect_word("return")
+        body = self.parse_expr()
+        return ast.CreateFunction(name, tuple(params), rtype, body)
 
     def qualified_name(self) -> str:
         parts = [self.expect_ident()]
@@ -443,6 +480,31 @@ class _Parser:
 
     def parse_relation_primary(self) -> ast.Relation:
         t = self.cur
+        if (t.kind == "kw" and t.text == "table"
+                and self.tokens[self.i + 1].text == "("):
+            # TABLE(fn(args...)) — polymorphic table function invocation
+            # (SqlBase.g4 tableFunctionCall)
+            self.advance()
+            self.expect_op("(")
+            fname = self.expect_ident().lower()
+            self.expect_op("(")
+            args: list[ast.Expr] = []
+            if not self.peek_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            self.expect_op(")")
+            alias = self._maybe_alias()
+            colnames = None
+            if alias is not None and self.accept_op("("):
+                cols = [self.expect_ident()]
+                while self.accept_op(","):
+                    cols.append(self.expect_ident())
+                self.expect_op(")")
+                colnames = tuple(cols)
+            return ast.TableFunctionRelation(fname, tuple(args), alias,
+                                             colnames)
         if (t.kind == "ident" and t.text.lower() == "unnest"
                 and self.tokens[self.i + 1].text == "("):
             self.advance()

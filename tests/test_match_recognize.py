@@ -1,0 +1,154 @@
+"""MATCH_RECOGNIZE (reference: sql/planner/rowpattern/ + operator/window/
+pattern/ — PatternRecognitionNode.java:47; behavior per SQL:2016 row
+pattern recognition; examples follow the docs' stock-ticker cases)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.exec.row_pattern import PatternMatcher, parse_pattern
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01),
+                              session=Session(default_catalog="memory"))
+    r.execute("create table ticker (symbol varchar, day bigint, price bigint)")
+    r.execute("insert into ticker values "
+              "('a',1,10),('a',2,8),('a',3,6),('a',4,9),('a',5,12),"
+              "('a',6,11),('a',7,11),"
+              "('b',1,5),('b',2,6),('b',3,4),('b',4,7)")
+    return r
+
+
+def test_v_shape(runner):
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures match_number() as mno, first(a.day) as sd,
+                   last(down.day) as bd, last(up.day) as ed,
+                   last(up.price) as ep
+          one row per match after match skip past last row
+          pattern (a down+ up+)
+          define down as price < prev(price), up as price > prev(price)
+        ) order by symbol, mno""").rows()
+    assert rows == [("a", 1, 1, 3, 5, 12), ("b", 1, 2, 3, 4, 7)]
+
+
+def test_classifier_and_aggregates(runner):
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures count(*) as n, avg(down.price) as adp,
+                   classifier() as last_label
+          pattern (a down+ up)
+          define down as price < prev(price), up as price > prev(price)
+        ) order by symbol""").rows()
+    assert rows == [("a", 4, 7.0, "UP"), ("b", 3, 4.0, "UP")]
+
+
+def test_quantifier_bounds(runner):
+    # exactly two DOWN rows required
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures first(down.day) as d1, last(down.day) as d2
+          pattern (down{2})
+          define down as price < prev(price)
+        ) order by symbol""").rows()
+    assert rows == [("a", 2, 3)]  # b has no two consecutive downs
+
+
+def test_alternation_and_skip_to_next(runner):
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures classifier() as c, last(day) as d
+          after match skip to next row
+          pattern (up | down)
+          define up as price > prev(price), down as price < prev(price)
+        ) order by symbol, d""").rows()
+    # every strictly-moving day classified (day 1 has no prev; day 7 flat)
+    assert [r for r in rows if r[0] == "a"] == [
+        ("a", "DOWN", 2), ("a", "DOWN", 3), ("a", "UP", 4), ("a", "UP", 5),
+        ("a", "DOWN", 6)]
+
+
+def test_undefined_label_matches_all(runner):
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures count(*) as n
+          pattern (x+)
+          define x as true
+        )""").rows()
+    assert sorted(rows) == [("a", 7), ("b", 4)]
+
+
+def test_distributed_match_recognize():
+    d = DistributedQueryRunner(default_catalog(scale_factor=0.01),
+                               worker_count=2,
+                               session=Session(default_catalog="memory",
+                                               node_count=2))
+    d.execute("create table mt (g bigint, seq bigint, v bigint)")
+    d.execute("insert into mt values (1,1,1),(1,2,2),(1,3,3),"
+              "(2,1,5),(2,2,4),(2,3,6)")
+    rows = d.execute("""
+        select * from mt match_recognize (
+          partition by g order by seq
+          measures count(*) as rising
+          pattern (up+)
+          define up as v > prev(v)
+        ) order by g""").rows()
+    assert rows == [(1, 2), (2, 1)]
+
+
+def test_pattern_engine_unit():
+    # direct NFA checks: greedy + backtracking
+    p = parse_pattern("A B* C")
+    seq = "ABBBC"
+    m = PatternMatcher(p, lambda l, i, ls: seq[i] == l).find_matches(len(seq))
+    assert len(m) == 1 and m[0].labels == ["A", "B", "B", "B", "C"]
+    # backtracking: B* must give back a row so C can match
+    seq2 = "ABB"
+    p2 = parse_pattern("A B* B")
+    m2 = PatternMatcher(p2, lambda l, i, ls: seq2[i] == "A" if l == "A"
+                        else seq2[i] == "B").find_matches(len(seq2))
+    assert len(m2) == 1 and m2[0].end == 3
+
+
+def test_min_max_at_exact_group_bucket():
+    # num_groups == cap (power of two) with dead padded rows: the last
+    # group's min/max must not read the trailing dead-row segment
+    # (kernels seg_minmax ends side='right' regression)
+    import numpy as np
+
+    from trino_tpu.exec import kernels as K
+    from trino_tpu.spi.batch import round_up_pow2
+
+    groups = 8  # == bucket(8)
+    per = 4
+    n = groups * per
+    cap_rows = round_up_pow2(n + 5)
+    g = np.repeat(np.arange(groups, dtype=np.int64), per)
+    v = np.arange(n, dtype=np.int64) + 100
+    data = np.concatenate([g, np.zeros(cap_rows - n, np.int64)])
+    vals = np.concatenate([v, np.zeros(cap_rows - n, np.int64)])
+    live = np.concatenate([np.ones(n, bool), np.zeros(cap_rows - n, bool)])
+    perm, gid, num = K.group_ids([(data, None)], live)
+    assert num == groups
+    out = K.grouped_reduce(perm, gid, num, [
+        ("min", vals, live, np.int64, False),
+        ("max", vals, live, np.int64, False)])
+    assert list(np.asarray(out[0][0])) == [100 + i * per
+                                           for i in range(groups)]
+    assert list(np.asarray(out[1][0])) == [100 + i * per + per - 1
+                                           for i in range(groups)]
+
+
+def test_bare_day_column_parses(runner):
+    # 'day' is a soft keyword (interval unit) AND a legal column name
+    assert runner.execute(
+        "select day from ticker where symbol = 'b' and day > 2 "
+        "order by day").rows() == [(3,), (4,)]

@@ -1,0 +1,140 @@
+"""Tracing spans, event listeners, access control, plugin loading
+(reference: tracing/TracingMetadata.java:121, spi/eventlistener/
+EventListener.java:16, security/AccessControlManager.java:97,
+server/PluginManager.java)."""
+
+import os
+import textwrap
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.plugin import PluginManager
+from trino_tpu.runner import Session, StandaloneQueryRunner
+from trino_tpu.spi.eventlistener import EventListener
+from trino_tpu.spi.security import (
+    AccessDeniedError,
+    DenyAllAccessControl,
+    RuleBasedAccessControl,
+    TableRule,
+)
+
+
+@pytest.fixture()
+def runner():
+    return StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+
+
+class Capture(EventListener):
+    def __init__(self):
+        self.created = []
+        self.completed = []
+
+    def query_created(self, e):
+        self.created.append(e)
+
+    def query_completed(self, e):
+        self.completed.append(e)
+
+
+def test_event_listener_success_and_failure(runner):
+    cap = Capture()
+    runner.event_listeners.add(cap)
+    runner.execute("select count(*) from nation")
+    assert len(cap.created) == 1 and len(cap.completed) == 1
+    done = cap.completed[0]
+    assert done.state == "FINISHED" and done.output_rows == 1
+    assert done.wall_ms > 0
+    with pytest.raises(Exception):
+        runner.execute("select no_such_col from nation")
+    assert cap.completed[-1].state == "FAILED"
+    assert cap.completed[-1].error
+
+
+def test_listener_exceptions_never_fail_queries(runner):
+    class Broken(EventListener):
+        def query_completed(self, e):
+            raise RuntimeError("boom")
+
+    runner.event_listeners.add(Broken())
+    assert runner.execute("select 1").rows() == [(1,)]
+
+
+def test_tracer_span_tree(runner):
+    runner.execute("select count(*) from nation")
+    root = runner.tracer.finished[-1]
+    assert root.name == "trino.query"
+    names = [c.name for c in root.children]
+    assert "trino.planner" in names and "trino.execution" in names
+    assert root.duration_ms >= max(c.duration_ms for c in root.children)
+    assert "query_id" in root.attributes
+
+
+def test_deny_all_access_control(runner):
+    runner.access_control.add(DenyAllAccessControl())
+    with pytest.raises(AccessDeniedError):
+        runner.execute("select * from nation")
+
+
+def test_rule_based_access_control():
+    runner = StandaloneQueryRunner(
+        default_catalog(scale_factor=0.01),
+        session=Session(user="alice", default_catalog="memory"))
+    runner.execute("create table t (v bigint)")  # allowed: default AllowAll
+    runner.access_control.add(RuleBasedAccessControl([
+        TableRule("alice", "tpch", "nation", {"SELECT"}),
+        TableRule("alice", "memory", "*", {"ALL"}),
+    ]))
+    assert runner.execute(
+        "select count(*) from tpch.nation").rows() == [(25,)]
+    with pytest.raises(AccessDeniedError):
+        runner.execute("select count(*) from tpch.region")
+    runner.execute("insert into t values (1)")  # ALL on memory.*
+    with pytest.raises(AccessDeniedError):
+        runner.execute("insert into tpch.nation select * from tpch.nation")
+
+
+def test_distributed_runner_observability():
+    d = DistributedQueryRunner(default_catalog(scale_factor=0.01),
+                               worker_count=2)
+    cap = Capture()
+    d.event_listeners.add(cap)
+    d.execute("select count(*) from tpch.region")
+    assert cap.completed[-1].state == "FINISHED"
+    assert d.tracer.finished[-1].name == "trino.query"
+    d.access_control.add(DenyAllAccessControl())
+    with pytest.raises(AccessDeniedError):
+        d.execute("select * from tpch.region")
+    assert cap.completed[-1].state == "FAILED"
+
+
+PLUGIN_SRC = textwrap.dedent('''
+    from trino_tpu.plugin import Plugin
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    class TinyPlugin(Plugin):
+        def get_connector_factories(self):
+            return {"tiny_memory": lambda config: MemoryConnector()}
+
+    def plugin():
+        return TinyPlugin()
+''')
+
+
+def test_plugin_loading(tmp_path):
+    path = os.path.join(tmp_path, "tiny_plugin.py")
+    with open(path, "w") as f:
+        f.write(PLUGIN_SRC)
+    cat = default_catalog(scale_factor=0.01)
+    pm = PluginManager(cat)
+    pm.load(path)
+    assert "tiny_memory" in pm.connector_factories()
+    pm.create_catalog("extra", "tiny_memory")
+    runner = StandaloneQueryRunner(cat, session=Session(
+        default_catalog="extra"))
+    runner.execute("create table p (v bigint)")
+    runner.execute("insert into p values (7)")
+    assert runner.execute("select v from p").rows() == [(7,)]
+    with pytest.raises(KeyError):
+        pm.create_catalog("x", "nope")

@@ -190,7 +190,10 @@ def _reduce_fn(spec: tuple, cap: int):
         n = perm.shape[0]
         ones = jnp.ones(perm.shape, dtype=jnp.int64)
         starts = jnp.searchsorted(gid, jnp.arange(cap))
-        ends = jnp.concatenate([starts[1:], jnp.array([n], starts.dtype)])
+        # end of group g = first row with gid > g (side='right'): when
+        # num_groups == cap, ends[cap-1] must STOP at the dead-row region
+        # (dead rows carry gid >= cap and form their own trailing segments)
+        ends = jnp.searchsorted(gid, jnp.arange(cap), side="right")
         nonempty = ends > starts
         seg_first = jnp.concatenate(
             [jnp.ones((1,), jnp.bool_), gid[1:] != gid[:-1]])

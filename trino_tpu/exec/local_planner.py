@@ -210,6 +210,17 @@ class LocalPlanner:
                 self.pipelines.append(chain)
             return [UnionSourceOperator(bridge)]
 
+        if isinstance(node, P.MatchRecognize):
+            from .match_recognize import MatchRecognizeOperator
+
+            chain = self._chain(node.source)
+            chain.append(MatchRecognizeOperator(
+                node.partition_channels, node.order_keys, node.pattern,
+                node.defines, node.measures, node.skip_past,
+                node.output_names, node.output_types,
+                node.source.output_names))
+            return chain
+
         if isinstance(node, P.Window):
             chain = self._chain(node.source)
             chain.append(WindowOperator(

@@ -1,0 +1,280 @@
+"""MATCH_RECOGNIZE row-pattern engine.
+
+Mirrors the reference's row-pattern stack (sql/planner/rowpattern/ pattern
+IR + operator/window/pattern/ matcher — LabelEvaluator.java,
+MatchAggregation.java; plan node PatternRecognitionNode.java:47) in a
+host-side engine: patterns compile to a Thompson NFA over label predicates
+and matching runs per partition with greedy quantifier semantics
+(backtracking, longest-match-first like the reference's matcher).
+
+Scope (the widely-used core): concatenation, alternation ``|``, grouping,
+quantifiers ``* + ? {n,m}``, ONE ROW PER MATCH, AFTER MATCH SKIP PAST LAST
+ROW / TO NEXT ROW, CLASSIFIER()/MATCH_NUMBER(), FIRST/LAST/PREV/NEXT in
+DEFINE/MEASURES, and aggregates over matched rows.  Pattern evaluation is
+inherently sequential per partition, so it lives on host — partitions
+themselves parallelize across tasks like any partitioned operator."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+__all__ = ["parse_pattern", "PatternMatcher", "Match"]
+
+
+# --------------------------------------------------------------------------
+# pattern AST + parser:  A (B|C)+ D?  {n,m} quantifiers
+
+
+@dataclass(frozen=True)
+class PLabel:
+    name: str
+
+
+@dataclass(frozen=True)
+class PSeq:
+    parts: tuple
+
+
+@dataclass(frozen=True)
+class PAlt:
+    options: tuple
+
+
+@dataclass(frozen=True)
+class PQuant:
+    inner: object
+    low: int
+    high: Optional[int]  # None = unbounded
+    greedy: bool = True
+
+
+class _PatternParser:
+    def __init__(self, tokens: list[str]):
+        self.toks = tokens
+        self.i = 0
+
+    @property
+    def cur(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def parse(self):
+        e = self._alt()
+        if self.cur is not None:
+            raise ValueError(f"unexpected pattern token {self.cur!r}")
+        return e
+
+    def _alt(self):
+        opts = [self._seq()]
+        while self.cur == "|":
+            self.i += 1
+            opts.append(self._seq())
+        return opts[0] if len(opts) == 1 else PAlt(tuple(opts))
+
+    def _seq(self):
+        parts = []
+        while self.cur is not None and self.cur not in ("|", ")"):
+            parts.append(self._quant())
+        if not parts:
+            raise ValueError("empty pattern")
+        return parts[0] if len(parts) == 1 else PSeq(tuple(parts))
+
+    def _quant(self):
+        atom = self._atom()
+        c = self.cur
+        if c == "*":
+            self.i += 1
+            return PQuant(atom, 0, None)
+        if c == "+":
+            self.i += 1
+            return PQuant(atom, 1, None)
+        if c == "?":
+            self.i += 1
+            return PQuant(atom, 0, 1)
+        if c == "{":
+            self.i += 1
+            lo = ""
+            while self.cur and self.cur.isdigit():
+                lo += self.cur
+                self.i += 1
+            hi: Optional[str] = lo
+            if self.cur == ",":
+                self.i += 1
+                hi = ""
+                while self.cur and self.cur.isdigit():
+                    hi += self.cur
+                    self.i += 1
+            if self.cur != "}":
+                raise ValueError("unterminated {n,m} quantifier")
+            self.i += 1
+            return PQuant(atom, int(lo or 0),
+                          int(hi) if hi else None)
+        return atom
+
+    def _atom(self):
+        c = self.cur
+        if c == "(":
+            self.i += 1
+            e = self._alt()
+            if self.cur != ")":
+                raise ValueError("unbalanced ( in pattern")
+            self.i += 1
+            return e
+        if c is None or not (c[0].isalpha() or c[0] == "_"):
+            raise ValueError(f"expected pattern label, got {c!r}")
+        self.i += 1
+        return PLabel(c.upper())
+
+
+def _tokenize_pattern(text: str) -> list[str]:
+    toks: list[str] = []
+    i = 0
+    while i < len(text):
+        ch = text[i]
+        if ch.isspace():
+            i += 1
+            continue
+        if ch.isalpha() or ch == "_":
+            j = i
+            while j < len(text) and (text[j].isalnum() or text[j] == "_"):
+                j += 1
+            toks.append(text[i:j])
+            i = j
+            continue
+        if ch.isdigit():
+            j = i
+            while j < len(text) and text[j].isdigit():
+                j += 1
+            toks.append(text[i:j])
+            i = j
+            continue
+        toks.append(ch)
+        i += 1
+    return toks
+
+
+def parse_pattern(text: str):
+    return _PatternParser(_tokenize_pattern(text)).parse()
+
+
+def pattern_labels(p) -> list[str]:
+    if isinstance(p, PLabel):
+        return [p.name]
+    if isinstance(p, PSeq):
+        out = []
+        for x in p.parts:
+            for l in pattern_labels(x):
+                if l not in out:
+                    out.append(l)
+        return out
+    if isinstance(p, PAlt):
+        out = []
+        for x in p.options:
+            for l in pattern_labels(x):
+                if l not in out:
+                    out.append(l)
+        return out
+    return pattern_labels(p.inner)
+
+
+# --------------------------------------------------------------------------
+# matcher: greedy backtracking over label predicates
+
+
+@dataclass
+class Match:
+    start: int  # partition-relative row index
+    end: int    # exclusive
+    labels: list[str]  # per matched row, the classifier label
+    match_number: int = 0
+
+
+class PatternMatcher:
+    """``predicate(label, row_idx, labels_so_far) -> bool`` decides whether
+    the DEFINE condition for ``label`` holds on the row given the current
+    prefix assignment (supports PREV/FIRST/LAST semantics in the caller).
+    Greedy quantifiers with backtracking — the reference matcher's
+    preferment order (Matcher.java over the pattern's preferred branches)."""
+
+    def __init__(self, pattern, predicate: Callable[[str, int, list], bool],
+                 max_rows_per_match: int = 10_000):
+        self.pattern = pattern
+        self.predicate = predicate
+        self.max_rows = max_rows_per_match
+
+    def _try(self, p, pos: int, n: int, labels: list) -> Optional[int]:
+        """Longest match of ``p`` starting at pos; returns end or None."""
+        if isinstance(p, PLabel):
+            if pos >= n or len(labels) >= self.max_rows:
+                return None
+            labels.append(p.name)
+            if self.predicate(p.name, pos, labels):
+                return pos + 1
+            labels.pop()
+            return None
+        if isinstance(p, PSeq):
+            return self._try_seq(p.parts, 0, pos, n, labels)
+        if isinstance(p, PAlt):
+            for opt in p.options:
+                mark = len(labels)
+                r = self._try(opt, pos, n, labels)
+                if r is not None:
+                    return r
+                del labels[mark:]
+            return None
+        if isinstance(p, PQuant):
+            return self._try_quant(p, pos, n, labels, 0)
+        raise TypeError(type(p).__name__)
+
+    def _try_seq(self, parts, k, pos, n, labels) -> Optional[int]:
+        if k == len(parts):
+            return pos
+        head = parts[k]
+        if isinstance(head, PQuant):
+            return self._try_quant(head, pos, n, labels, 0,
+                                   cont=(parts, k + 1))
+        mark = len(labels)
+        r = self._try(head, pos, n, labels)
+        if r is None:
+            return None
+        out = self._try_seq(parts, k + 1, r, n, labels)
+        if out is None:
+            del labels[mark:]
+        return out
+
+    def _try_quant(self, q: PQuant, pos, n, labels, count,
+                   cont=None) -> Optional[int]:
+        """Greedy: consume as many repetitions as possible, then backtrack
+        through the continuation."""
+        can_more = q.high is None or count < q.high
+        if can_more:
+            mark = len(labels)
+            r = self._try(q.inner, pos, n, labels)
+            if r is not None and (r > pos or count < q.low):
+                out = self._try_quant(q, r, n, labels, count + 1, cont)
+                if out is not None:
+                    return out
+            del labels[mark:]
+        if count >= q.low:
+            if cont is None:
+                return pos
+            return self._try_seq(cont[0], cont[1], pos, n, labels)
+        return None
+
+    def find_matches(self, n: int, skip_past_last: bool = True) -> list[Match]:
+        """Scan a partition of ``n`` rows, emitting non-overlapping matches
+        (AFTER MATCH SKIP PAST LAST ROW) or all matches advancing one row
+        (SKIP TO NEXT ROW)."""
+        out: list[Match] = []
+        pos = 0
+        mn = 0
+        while pos < n:
+            labels: list[str] = []
+            end = self._try(self.pattern, pos, n, labels)
+            if end is not None and end > pos:
+                mn += 1
+                out.append(Match(pos, end, list(labels), mn))
+                pos = end if skip_past_last else pos + 1
+            else:
+                pos += 1
+        return out

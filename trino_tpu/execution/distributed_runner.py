@@ -73,14 +73,29 @@ class DistributedQueryRunner:
             "global",
             hard_concurrency_limit=self.session.query_concurrency,
             max_queued=self.session.query_max_queued))
+        import itertools
+
+        from ..spi.eventlistener import EventListenerManager
+        from ..spi.security import AccessControlManager
+        from .tracing import Tracer
+
+        self.tracer = Tracer()
+        self.event_listeners = EventListenerManager()
+        self.access_control = AccessControlManager()
+        self._qids = itertools.count(1)
 
     # ------------------------------------------------------------------ plan
     def create_plan(self, sql: str) -> PlanNode:
         return self._plan_stmt(parse_statement(sql))
 
     def _plan_stmt(self, stmt: ast.Statement) -> PlanNode:
-        plan = LogicalPlanner(self.catalog, self.session.default_catalog).plan(stmt)
-        plan = optimize(plan, self.catalog)
+        from ..runner import check_select_access
+
+        with self.tracer.span("trino.planner"):
+            plan = LogicalPlanner(
+                self.catalog, self.session.default_catalog).plan(stmt)
+            plan = optimize(plan, self.catalog)
+        check_select_access(plan, self.access_control, self.session.user)
         writer_tasks = 1
         if self.session.scale_writers:
             writer_tasks = max(1, min(self.session.writer_task_limit,
@@ -95,12 +110,23 @@ class DistributedQueryRunner:
 
     # --------------------------------------------------------------- execute
     def execute(self, sql: str) -> QueryResult:
+        from ..runner import run_with_query_events
+
+        return run_with_query_events(
+            f"dq_{next(self._qids)}", sql, self.session.user,
+            self.event_listeners, self.tracer, lambda: self._execute(sql))
+
+    def _execute(self, sql: str) -> QueryResult:
+        from ..runner import check_ddl_access
+
         stmt = parse_statement(sql)
         from .transaction import handle_transaction_stmt
 
         txn = handle_transaction_stmt(stmt, self.session, self.catalog)
         if txn is not None:
             return txn
+        check_ddl_access(stmt, self.access_control, self.session.user,
+                         self.session.default_catalog)
         if isinstance(stmt, ast.Explain):
             subplan = fragment_plan(self._plan_stmt(stmt.statement))
             lines = subplan.text().splitlines()

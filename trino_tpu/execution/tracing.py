@@ -1,0 +1,101 @@
+"""Tracing: per-query span trees (the OpenTelemetry role).
+
+Mirrors the reference's tracing layer (tracing/TracingMetadata.java:121
+decorators, tracing/TrinoAttributes.java span vocabulary, spans per
+query/stage/task propagated into workers) without the OTel SDK dependency:
+spans are plain objects collected per query; an exporter hook receives
+finished root spans (plug an OTLP exporter there in a deployment).  The
+attribute names follow the reference's ``trino.*`` vocabulary."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = ["Span", "Tracer"]
+
+
+@dataclass
+class Span:
+    name: str
+    attributes: dict = field(default_factory=dict)
+    start: float = 0.0
+    end: Optional[float] = None
+    children: list["Span"] = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end or time.perf_counter()) - self.start) * 1e3
+
+    def set(self, key: str, value) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def text(self, indent: int = 0) -> str:
+        attrs = " ".join(f"{k}={v}" for k, v in self.attributes.items())
+        lines = ["  " * indent
+                 + f"- {self.name} {self.duration_ms:.1f}ms"
+                 + (f" [{attrs}]" if attrs else "")]
+        for c in self.children:
+            lines.append(c.text(indent + 1))
+        return "\n".join(lines)
+
+
+class _SpanCtx:
+    def __init__(self, tracer: "Tracer", span: Span):
+        self.tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Span:
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.span.end = time.perf_counter()
+        if exc is not None:
+            self.span.set("error", type(exc).__name__)
+        self.tracer._pop(self.span)
+
+
+class Tracer:
+    """Thread-aware span collector.  ``span(name)`` nests under the current
+    thread's open span; finished ROOT spans go to ``exporter`` and the
+    bounded ``finished`` ring (introspection / tests)."""
+
+    def __init__(self, exporter: Optional[Callable[[Span], None]] = None,
+                 keep: int = 50):
+        self._local = threading.local()
+        self._exporter = exporter
+        self._keep = keep
+        self.finished: list[Span] = []
+        self._lock = threading.Lock()
+
+    def _stack(self) -> list:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    def span(self, name: str, **attributes) -> _SpanCtx:
+        s = Span(name, dict(attributes), time.perf_counter())
+        stack = self._stack()
+        if stack:
+            stack[-1].children.append(s)
+        stack.append(s)
+        return _SpanCtx(self, s)
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def _pop(self, span: Span) -> None:
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        if not stack:  # root finished
+            with self._lock:
+                self.finished.append(span)
+                while len(self.finished) > self._keep:
+                    self.finished.pop(0)
+            if self._exporter is not None:
+                self._exporter(span)

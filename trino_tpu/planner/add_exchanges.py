@@ -36,6 +36,7 @@ from .plan import (
     GroupId,
     Join,
     Limit,
+    MatchRecognize,
     Output,
     PlanNode,
     Project,
@@ -139,6 +140,15 @@ def _visit(node: PlanNode, single: bool, writer_tasks: int = 1) -> PlanNode:
                        node.source_keys, node.filter_keys, node.negated,
                        node.residual, node.null_aware)
         return _gather_if(out, single)
+
+    if isinstance(node, MatchRecognize):
+        src = _visit(node.source, single=False)
+        if node.partition_channels:
+            src = _exchange(src, "REPARTITION", node.partition_channels)
+        else:
+            src = _exchange(src, "GATHER")
+        out = _replace_source(node, src)
+        return _gather_if(out, single and bool(node.partition_channels))
 
     if isinstance(node, Window):
         src = _visit(node.source, single=False)

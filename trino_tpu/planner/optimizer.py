@@ -31,6 +31,7 @@ from .plan import (
     GroupId,
     Join,
     Limit,
+    MatchRecognize,
     Output,
     PlanNode,
     Project,
@@ -328,6 +329,12 @@ def _rewrite(node: PlanNode, catalog: Catalog) -> tuple[PlanNode, list[int]]:
                       replicate=tuple(m[c] for c in node.replicate),
                       unnest_channels=tuple(m[c] for c in node.unnest_channels))
         return out, _identity(node)
+
+    if isinstance(node, MatchRecognize):
+        child, m = _rewrite(node.source, catalog)
+        if m != list(range(len(child.output_types))):
+            child = _restore_layout(child, m, node.source)
+        return replace(node, source=child), _identity(node)
 
     if isinstance(node, Window):
         child, m = _rewrite(node.source, catalog)
@@ -780,6 +787,13 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
                       replicate=tuple(cm[c] for c in node.replicate),
                       unnest_channels=tuple(cm[c] for c in node.unnest_channels))
         return out, list(range(len(node.output_types)))
+
+    if isinstance(node, MatchRecognize):
+        # DEFINE/MEASURES reference source columns BY NAME in the host
+        # pattern engine: the full input layout must survive
+        child, cm = _prune(node.source,
+                           set(range(len(node.source.output_types))))
+        return replace(node, source=child), list(range(len(node.output_types)))
 
     if isinstance(node, Window):
         sw = len(node.source.output_types)

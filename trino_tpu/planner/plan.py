@@ -20,7 +20,7 @@ from ..sql.ir import RowExpression
 
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
-    "GroupId", "Unnest", "TableFunctionScan",
+    "GroupId", "Unnest", "TableFunctionScan", "MatchRecognize",
     "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
     "Window", "WindowFunc", "Union", "Replicate", "plan_text",
@@ -256,6 +256,31 @@ class Window(PlanNode):
             for k in self.order_keys)
         return (f"Window[partition={list(self.partition_keys)} "
                 f"order=[{keys}] {fns}]")
+
+
+@dataclass(frozen=True)
+class MatchRecognize(PlanNode):
+    """ONE ROW PER MATCH row-pattern recognition (reference:
+    sql/planner/plan/PatternRecognitionNode.java:47).  Output channels =
+    partition columns ++ measures.  DEFINE/MEASURES stay as AST expressions
+    (evaluated by the host pattern engine; channel indices would buy
+    nothing — pattern matching is inherently row-sequential)."""
+
+    source: PlanNode = None
+    partition_channels: tuple[int, ...] = ()
+    order_keys: tuple[tuple[int, bool], ...] = ()  # (channel, ascending)
+    pattern: str = ""
+    defines: tuple = ()    # ((label, ast.Expr), ...)
+    measures: tuple = ()   # ((ast.Expr, name), ...)
+    skip_past: bool = True
+
+    @property
+    def children(self):
+        return (self.source,)
+
+    def label(self) -> str:
+        return (f"MatchRecognize[PATTERN({self.pattern}) "
+                f"partition={list(self.partition_channels)}]")
 
 
 @dataclass(frozen=True)

@@ -135,6 +135,65 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
     return None
 
 
+def run_with_query_events(qid: str, sql: str, user: str, listeners, tracer,
+                          thunk):
+    """Shared query lifecycle wrapper: created/completed events + the root
+    tracing span around ``thunk`` (both runners use this; reference:
+    QueryMonitor emitting eventlistener events around the dispatch)."""
+    import time as _time
+
+    from .spi.eventlistener import QueryCompletedEvent, QueryCreatedEvent
+
+    listeners.query_created(QueryCreatedEvent(qid, sql, user))
+    t0 = _time.perf_counter()
+    try:
+        with tracer.span("trino.query", query_id=qid):
+            result = thunk()
+    except BaseException as e:
+        listeners.query_completed(QueryCompletedEvent(
+            qid, sql, "FAILED", user,
+            (_time.perf_counter() - t0) * 1e3, -1, str(e)))
+        raise
+    rows = result.batch.live_count if result.batch.columns else 0
+    listeners.query_completed(QueryCompletedEvent(
+        qid, sql, "FINISHED", user,
+        (_time.perf_counter() - t0) * 1e3, rows))
+    return result
+
+
+def check_select_access(plan, access_control, user: str) -> None:
+    """Every table the plan scans needs SELECT on its projected columns
+    (reference: AccessControlManager.checkCanSelectFromColumns called from
+    StatementAnalyzer)."""
+    from .planner.plan import TableScan
+
+    def walk(node):
+        if isinstance(node, TableScan):
+            access_control.check_can_select(
+                user, node.catalog, node.table, node.columns)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+
+
+def check_ddl_access(stmt, access_control, user: str,
+                     default_catalog_name: str) -> None:
+    """Pre-execution privilege checks for metadata/write statements."""
+    if isinstance(stmt, (ast.CreateTable, ast.CreateTableAsSelect)):
+        cat, table = _split_name(stmt.table, default_catalog_name)
+        access_control.check_can_create_table(user, cat, table)
+    elif isinstance(stmt, ast.DropTable):
+        cat, table = _split_name(stmt.table, default_catalog_name)
+        access_control.check_can_drop_table(user, cat, table)
+    elif isinstance(stmt, ast.InsertInto):
+        cat, table = _split_name(stmt.table, default_catalog_name)
+        access_control.check_can_insert(user, cat, table)
+    elif isinstance(stmt, ast.Delete):
+        cat, table = _split_name(stmt.table, default_catalog_name)
+        access_control.check_can_delete(user, cat, table)
+
+
 def _split_name(name: str, default: str) -> tuple[str, str]:
     parts = name.split(".")
     if len(parts) == 1:
@@ -156,6 +215,7 @@ class Session:
     """Per-query knobs (the SystemSessionProperties miniature)."""
 
     default_catalog: str = "tpch"
+    user: str = "user"
     splits_per_node: int = 4
     node_count: int = 1
     dynamic_filtering: bool = True
@@ -193,27 +253,47 @@ class Session:
 class StandaloneQueryRunner:
     def __init__(self, catalog: Optional[Catalog] = None,
                  session: Optional[Session] = None):
+        import itertools
+
+        from .execution.tracing import Tracer
+        from .spi.eventlistener import EventListenerManager
+        from .spi.security import AccessControlManager
+
         self.catalog = catalog if catalog is not None else default_catalog()
         self.session = session if session is not None else Session()
+        self.tracer = Tracer()
+        self.event_listeners = EventListenerManager()
+        self.access_control = AccessControlManager()
+        self._qids = itertools.count(1)
 
     def create_plan(self, sql: str) -> PlanNode:
         return self._plan_stmt(parse_statement(sql))
 
     def _plan_stmt(self, stmt: ast.Statement) -> PlanNode:
-        planner = LogicalPlanner(self.catalog, self.session.default_catalog)
-        plan = planner.plan(stmt)
-        return optimize(plan, self.catalog)
+        with self.tracer.span("trino.planner"):
+            planner = LogicalPlanner(self.catalog, self.session.default_catalog)
+            plan = planner.plan(stmt)
+            plan = optimize(plan, self.catalog)
+        check_select_access(plan, self.access_control, self.session.user)
+        return plan
 
     def explain(self, sql: str) -> str:
         return plan_text(self.create_plan(sql))
 
     def execute(self, sql: str) -> QueryResult:
+        return run_with_query_events(
+            f"sq_{next(self._qids)}", sql, self.session.user,
+            self.event_listeners, self.tracer, lambda: self._execute(sql))
+
+    def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         from .execution.transaction import handle_transaction_stmt
 
         txn = handle_transaction_stmt(stmt, self.session, self.catalog)
         if txn is not None:
             return txn
+        check_ddl_access(stmt, self.access_control, self.session.user,
+                         self.session.default_catalog)
         if isinstance(stmt, ast.Explain):
             return self._execute_explain(stmt)
         if isinstance(stmt, ast.ShowTables):
@@ -245,7 +325,8 @@ class StandaloneQueryRunner:
             spill_to_disk_bytes=self.session.spill_to_disk_bytes,
         ).plan(plan)
         stats = QueryStats() if collect_stats else None
-        run_pipelines(local.pipelines, stats)
+        with self.tracer.span("trino.execution"):
+            run_pipelines(local.pipelines, stats)
         batches = local.collector.batches
         if batches:
             batch = ColumnBatch.concat(batches)

@@ -250,6 +250,22 @@ class SubqueryRelation(Relation):
 
 
 @dataclass(frozen=True)
+class MatchRecognizeRelation(Relation):
+    """input MATCH_RECOGNIZE (PARTITION BY ... ORDER BY ... MEASURES ...
+    PATTERN (...) DEFINE ...) (reference: sql/tree/PatternRecognitionRelation
+    .java; SqlBase.g4 patternRecognition)."""
+
+    input: Relation
+    partition_by: tuple[Expr, ...]
+    order_by: tuple["SortItem", ...]
+    measures: tuple[tuple[Expr, str], ...]  # (expr, output name)
+    pattern: str
+    defines: tuple[tuple[str, Expr], ...]  # (label, condition)
+    skip_past: bool = True  # AFTER MATCH SKIP PAST LAST ROW (default)
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class TableFunctionRelation(Relation):
     """TABLE(fn(args...)) (reference: spi/function/table/
     ConnectorTableFunction.java; executed by LeafTableFunctionOperator)."""

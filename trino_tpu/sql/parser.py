@@ -43,7 +43,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||->|[=<>+\-*/%(),.;\[\]])
+  | (?P<op><>|!=|>=|<=|\|\||->|[=<>+\-*/%(),.;\[\]{}|?])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -540,8 +540,82 @@ class _Parser:
                 colnames = tuple(cols)
             return ast.SubqueryRelation(q, alias, colnames)
         name = self.qualified_name()
+        if (self.cur.kind == "ident"
+                and self.cur.text.lower() == "match_recognize"
+                and self.tokens[self.i + 1].text == "("):
+            return self._parse_match_recognize(ast.Table(name))
         alias = self._maybe_alias()
         return ast.Table(name, alias)
+
+    def _parse_match_recognize(self, input_rel) -> ast.Relation:
+        """MATCH_RECOGNIZE (...) suffix (SqlBase.g4 patternRecognition)."""
+        self.advance()  # match_recognize
+        self.expect_op("(")
+        partition: tuple = ()
+        if self.accept_word("partition"):
+            self.expect_kw("by")
+            ps = [self.parse_expr()]
+            while self.accept_op(","):
+                ps.append(self.parse_expr())
+            partition = tuple(ps)
+        order: tuple = ()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = tuple(self.parse_sort_items())
+        measures: list[tuple] = []
+        if self.accept_word("measures"):
+            while True:
+                e = self.parse_expr()
+                self.expect_kw("as")
+                measures.append((e, self.expect_ident()))
+                if not self.accept_op(","):
+                    break
+        if self.accept_word("one"):
+            self.expect_word("row")
+            self.expect_word("per")
+            self.expect_word("match")
+        skip_past = True
+        if self.accept_word("after"):
+            self.expect_word("match")
+            self.expect_word("skip")
+            if self.accept_word("past"):
+                self.expect_kw("last")
+                self.expect_word("row")
+            else:
+                self.expect_word("to")
+                self.expect_word("next")
+                self.expect_word("row")
+                skip_past = False
+        self.expect_word("pattern")
+        self.expect_op("(")
+        # capture raw pattern text up to the balanced close paren
+        depth = 1
+        toks: list[str] = []
+        while depth > 0:
+            t = self.advance()
+            if t.kind == "eof":
+                self.fail("unterminated PATTERN")
+            if t.kind == "op" and t.text == "(":
+                depth += 1
+            elif t.kind == "op" and t.text == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            toks.append(t.text)
+        pattern = " ".join(toks)
+        self.expect_word("define")
+        defines: list[tuple] = []
+        while True:
+            label = self.expect_ident()
+            self.expect_kw("as")
+            defines.append((label, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        alias = self._maybe_alias()
+        return ast.MatchRecognizeRelation(
+            input_rel, partition, order, tuple(measures), pattern,
+            tuple(defines), skip_past, alias)
 
     def _maybe_alias(self) -> Optional[str]:
         if self.accept_kw("as"):
@@ -762,8 +836,8 @@ class _Parser:
                 self.expect_op(")")
                 args = (inner, start) + ((length,) if length is not None else ())
                 return ast.FunctionCall("substring", args)
-            if t.text in ("year", "month", "day", "quarter"):
-                # allow year(x) style
+            if t.text in ("year", "month", "day", "quarter", "first", "last"):
+                # allow year(x) / FIRST(a.x) / LAST(a.x) call style
                 nxt = self.tokens[self.i + 1]
                 if nxt.kind == "op" and nxt.text == "(":
                     self.advance()
@@ -771,6 +845,12 @@ class _Parser:
                     inner = self.parse_expr()
                     self.expect_op(")")
                     return ast.FunctionCall(t.text, (inner,))
+                # bare soft keyword as a column name (a column named "day")
+                self.advance()
+                e: ast.Expr = ast.ColumnRef((t.text,))
+                while self.accept_op("."):
+                    e = ast.ColumnRef(e.parts + (self.expect_ident(),))
+                return e
         if t.kind == "op" and t.text == "(":
             self.advance()
             if self.peek_kw("select", "with"):

@@ -1,0 +1,72 @@
+"""JSON functions (reference: operator/scalar/JsonFunctions,
+json/JsonPathEvaluator.java): path evaluation over dictionary-encoded
+varchar, NULL-on-error semantics."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.ops.json_fns import eval_json_path, parse_json_path
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01),
+                              session=Session(default_catalog="memory"))
+    r.execute("create table j (id bigint, doc varchar)")
+    r.execute("""insert into j values
+        (1, '{"a": 1, "b": {"c": "x"}, "arr": [10, 20, 30]}'),
+        (2, '{"a": 2.5, "b": {"c": "y"}, "arr": []}'),
+        (3, 'not json'),
+        (4, null)""")
+    return r
+
+
+def test_path_parser():
+    assert parse_json_path("$.a.b") == ["a", "b"]
+    assert parse_json_path("$.arr[2].x") == ["arr", 2, "x"]
+    assert parse_json_path('$["k"]') == ["k"]
+    with pytest.raises(ValueError):
+        parse_json_path("a.b")
+
+
+def test_eval_path():
+    doc = '{"a": {"b": [1, 2]}}'
+    assert eval_json_path(doc, ["a", "b", 1]) == 2
+    assert eval_json_path(doc, ["a", "x"]) is None
+    assert eval_json_path("garbage", ["a"]) is None
+
+
+def test_json_extract_scalar(runner):
+    assert runner.execute(
+        "select id, json_extract_scalar(doc, '$.b.c') from j order by id"
+    ).rows() == [(1, "x"), (2, "y"), (3, None), (4, None)]
+    # numbers render as text; integral floats without trailing .0
+    assert runner.execute(
+        "select json_extract_scalar(doc, '$.a') from j where id <= 2 "
+        "order by id").rows() == [("1",), ("2.5",)]
+    # objects/arrays -> NULL for the scalar variant
+    assert runner.execute(
+        "select json_extract_scalar(doc, '$.b') from j where id = 1"
+    ).rows() == [(None,)]
+
+
+def test_json_extract(runner):
+    assert runner.execute(
+        "select json_extract(doc, '$.b') from j where id = 1"
+    ).rows() == [('{"c": "x"}',)]
+    assert runner.execute(
+        "select json_extract(doc, '$.arr[1]') from j where id = 1"
+    ).rows() == [("20",)]
+
+
+def test_json_array_length(runner):
+    assert runner.execute(
+        "select id, json_array_length(json_extract(doc, '$.arr')) from j "
+        "order by id").rows() == [(1, 3), (2, 0), (3, None), (4, None)]
+
+
+def test_json_in_predicate(runner):
+    assert runner.execute(
+        "select id from j where json_extract_scalar(doc, '$.b.c') = 'y'"
+    ).rows() == [(2,)]

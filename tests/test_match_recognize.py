@@ -104,6 +104,53 @@ def test_distributed_match_recognize():
     assert rows == [(1, 2), (2, 1)]
 
 
+def test_alternation_backtracks_into_branches():
+    # ((A B | A) B): the first alternative consumes both rows, the trailing
+    # B fails, and the matcher must retry the A-only branch
+    seq = "AB"
+
+    def pred(l, i, ls):
+        return seq[i] == l
+
+    p = parse_pattern("(A B | A) B")
+    m = PatternMatcher(p, pred).find_matches(len(seq))
+    assert len(m) == 1 and m[0].labels == ["A", "B"]
+    p2 = parse_pattern("(A B | A)+ B")
+    m2 = PatternMatcher(p2, pred).find_matches(len(seq))
+    assert len(m2) == 1 and m2[0].labels == ["A", "B"]
+
+
+def test_match_number_in_define(runner):
+    # MATCH_NUMBER() usable inside DEFINE: only the 2nd match fires
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures last(day) as d
+          after match skip to next row
+          pattern (dn)
+          define dn as price < prev(price) and match_number() >= 2
+        ) order by symbol, d""").rows()
+    # symbol a downs at days 2,3,6: first candidate (day2) is match 1 and is
+    # rejected by the predicate, so day2 never matches; days 3 and 6 do...
+    # but rejecting match 1 means the counter stays 1 until a match lands.
+    assert rows == []
+
+
+def test_prev_with_label_anchor(runner):
+    # PREV(A.price) navigates from the LAST A-labeled row, not current row
+    rows = runner.execute("""
+        select * from ticker match_recognize (
+          partition by symbol order by day
+          measures first(a.price) as ap, last(b.price) as bp
+          pattern (a b)
+          define b as price < prev(a.price, 0) - 1
+        ) order by symbol""").rows()
+    # b requires price < (last A row's price) - 1: symbol a matches at
+    # days 1-2 (8 < 10-1); symbol b at days 2-3 (4 < 6-1 — the scan
+    # retries from day 2 after day 1's candidate fails)
+    assert rows == [("a", 10, 8), ("b", 6, 4)]
+
+
 def test_pattern_engine_unit():
     # direct NFA checks: greedy + backtracking
     p = parse_pattern("A B* C")

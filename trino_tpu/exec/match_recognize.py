@@ -163,7 +163,15 @@ def _eval_call(e: ast.FunctionCall, ctx: _Ctx):
         if not isinstance(arg, ast.ColumnRef):
             raise NotImplementedError(f"{name}() needs a column reference")
         col = arg.parts[-1].lower()
-        idx = ctx.cur + (-off if name == "prev" else off)
+        if len(arg.parts) == 2:
+            # PREV(A.x): navigate from the LAST row labeled A (SQL:2016)
+            anchor = ctx.rows_with_label(arg.parts[0].upper())
+            if not anchor:
+                return None
+            base = anchor[-1]
+        else:
+            base = ctx.cur
+        idx = base + (-off if name == "prev" else off)
         if idx < 0 or idx >= len(ctx.rows):
             return None
         return ctx.rows[idx].get(col)
@@ -298,8 +306,6 @@ class MatchRecognizeOperator(BufferedInputMixin, Operator):
             part_rows = [rows[i] for i in idx[start:end]]
             out_rows.extend(self._match_partition(part_rows))
             start = end
-        if not out_rows:
-            out_rows = []
         cols = []
         for j, t in enumerate(self.output_types):
             cols.append(Column.from_values(
@@ -307,20 +313,20 @@ class MatchRecognizeOperator(BufferedInputMixin, Operator):
         return ColumnBatch(self.output_names, cols)
 
     def _match_partition(self, part_rows: list[dict]) -> list[tuple]:
-        mn_box = {"n": 0}
+        holder: dict = {}
 
         def predicate(label: str, pos: int, labels: list[str]) -> bool:
             cond = self.defines.get(label)
             if cond is None:
                 return True  # undefined label matches any row (spec)
             ctx = _Ctx(part_rows, pos - len(labels) + 1, labels,
-                       mn_box["n"] + 1, True)
+                       holder["m"].next_match_number, True)
             return _eval(cond, ctx) is True
 
         matcher = PatternMatcher(self.pattern, predicate)
+        holder["m"] = matcher
         out = []
         for m in matcher.find_matches(len(part_rows), self.skip_past):
-            mn_box["n"] = m.match_number
             ctx = _Ctx(part_rows, m.start, m.labels, m.match_number, False)
             row = []
             for c in self.partition_channels:

@@ -201,74 +201,78 @@ class PatternMatcher:
         self.pattern = pattern
         self.predicate = predicate
         self.max_rows = max_rows_per_match
+        self.next_match_number = 1
 
     def _try(self, p, pos: int, n: int, labels: list) -> Optional[int]:
-        """Longest match of ``p`` starting at pos; returns end or None."""
+        """Longest (greedy, preferment-ordered) match of ``p`` at pos."""
+        return self._match(p, pos, n, labels, lambda end: end)
+
+    def _match(self, p, pos: int, n: int, labels: list,
+               cont) -> Optional[int]:
+        """Full-backtracking CPS matcher: ``cont(pos')`` tries the REST of
+        the pattern; a failing continuation re-enters earlier alternatives
+        and shorter quantifier expansions (the reference matcher's
+        preferment order over every branch point)."""
         if isinstance(p, PLabel):
             if pos >= n or len(labels) >= self.max_rows:
                 return None
             labels.append(p.name)
             if self.predicate(p.name, pos, labels):
-                return pos + 1
+                r = cont(pos + 1)
+                if r is not None:
+                    return r
             labels.pop()
             return None
         if isinstance(p, PSeq):
-            return self._try_seq(p.parts, 0, pos, n, labels)
+            def seq_cont(k):
+                if k == len(p.parts):
+                    return cont
+                return lambda pos2: self._match(
+                    p.parts[k], pos2, n, labels, seq_cont(k + 1))
+
+            return seq_cont(0)(pos)
         if isinstance(p, PAlt):
             for opt in p.options:
                 mark = len(labels)
-                r = self._try(opt, pos, n, labels)
+                r = self._match(opt, pos, n, labels, cont)
                 if r is not None:
                     return r
                 del labels[mark:]
             return None
         if isinstance(p, PQuant):
-            return self._try_quant(p, pos, n, labels, 0)
+            q = p
+
+            def rep(pos2: int, count: int) -> Optional[int]:
+                if q.high is None or count < q.high:
+                    mark = len(labels)
+
+                    def more(pos3: int) -> Optional[int]:
+                        if pos3 == pos2:
+                            # zero-width repetition: stop expanding
+                            return cont(pos3) if count + 1 >= q.low else None
+                        return rep(pos3, count + 1)
+
+                    r = self._match(q.inner, pos2, n, labels, more)
+                    if r is not None:
+                        return r
+                    del labels[mark:]
+                if count >= q.low:
+                    return cont(pos2)
+                return None
+
+            return rep(pos, 0)
         raise TypeError(type(p).__name__)
-
-    def _try_seq(self, parts, k, pos, n, labels) -> Optional[int]:
-        if k == len(parts):
-            return pos
-        head = parts[k]
-        if isinstance(head, PQuant):
-            return self._try_quant(head, pos, n, labels, 0,
-                                   cont=(parts, k + 1))
-        mark = len(labels)
-        r = self._try(head, pos, n, labels)
-        if r is None:
-            return None
-        out = self._try_seq(parts, k + 1, r, n, labels)
-        if out is None:
-            del labels[mark:]
-        return out
-
-    def _try_quant(self, q: PQuant, pos, n, labels, count,
-                   cont=None) -> Optional[int]:
-        """Greedy: consume as many repetitions as possible, then backtrack
-        through the continuation."""
-        can_more = q.high is None or count < q.high
-        if can_more:
-            mark = len(labels)
-            r = self._try(q.inner, pos, n, labels)
-            if r is not None and (r > pos or count < q.low):
-                out = self._try_quant(q, r, n, labels, count + 1, cont)
-                if out is not None:
-                    return out
-            del labels[mark:]
-        if count >= q.low:
-            if cont is None:
-                return pos
-            return self._try_seq(cont[0], cont[1], pos, n, labels)
-        return None
 
     def find_matches(self, n: int, skip_past_last: bool = True) -> list[Match]:
         """Scan a partition of ``n`` rows, emitting non-overlapping matches
         (AFTER MATCH SKIP PAST LAST ROW) or all matches advancing one row
-        (SKIP TO NEXT ROW)."""
+        (SKIP TO NEXT ROW).  ``next_match_number`` is live during the scan
+        so DEFINE predicates can evaluate MATCH_NUMBER()."""
         out: list[Match] = []
         pos = 0
         mn = 0
         while pos < n:
+            self.next_match_number = mn + 1
             labels: list[str] = []
             end = self._try(self.pattern, pos, n, labels)
             if end is not None and end > pos:

@@ -856,6 +856,51 @@ def _array_position_handler(out_type, args):
         args[1:])
 
 
+def _json_extract_handler(scalar: bool):
+    """json_extract[_scalar](json, '$.path'): host path evaluation over the
+    dictionary + device gather (ops/json_fns.py; reference:
+    json/JsonPathEvaluator.java, operator/scalar/JsonFunctions)."""
+
+    def handler(out_type, args):
+        import json as _json
+
+        from .json_fns import eval_json_path, json_scalar_text, parse_json_path
+
+        col = args[0]
+        if col.dictionary is None:
+            raise NotImplementedError("json function on non-dictionary column")
+        steps = parse_json_path(_literal_str(args[1]))
+        vals = []
+        for v in col.dictionary:
+            r = eval_json_path(str(v), steps)
+            if scalar:
+                vals.append(json_scalar_text(r))
+            else:
+                vals.append(None if r is None else _json.dumps(r))
+        return _and_extra_valid(
+            _array_table_lookup(col, vals, VARCHAR), args[1:])
+
+    return handler
+
+
+def _json_array_length_handler(out_type, args):
+    import json as _json
+
+    col = args[0]
+    if col.dictionary is None:
+        raise NotImplementedError("json function on non-dictionary column")
+
+    def length(v):
+        try:
+            doc = _json.loads(str(v))
+        except (ValueError, TypeError):
+            return None
+        return len(doc) if isinstance(doc, list) else None
+
+    return _array_table_lookup(
+        col, [length(v) for v in col.dictionary], BIGINT)
+
+
 def _grouping_mask_handler(out_type, args):
     """grouping() lowering: constant-table gather by the $groupid channel
     (args = [groupid column, one mask literal per grouping set])."""
@@ -872,6 +917,9 @@ def _grouping_mask_handler(out_type, args):
 HANDLERS: dict[str, Callable] = {
     "$grouping_mask": _grouping_mask_handler,
     "cardinality": _cardinality_handler,
+    "json_extract": _json_extract_handler(scalar=False),
+    "json_extract_scalar": _json_extract_handler(scalar=True),
+    "json_array_length": _json_array_length_handler,
     "element_at": _element_at_handler,
     "contains": _contains_handler,
     "array_position": _array_position_handler,

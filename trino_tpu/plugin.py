@@ -68,6 +68,15 @@ class PluginManager:
     def connector_factories(self) -> dict:
         return dict(self._factories)
 
+    def create_event_listener(self, name: str, config: Optional[dict] = None):
+        """Instantiate a plugin-provided event listener (register it on a
+        runner via ``runner.event_listeners.add``; reference: PluginManager
+        wiring EventListenerFactory into the EventListenerManager)."""
+        if name not in self._listener_factories:
+            raise KeyError(f"no such event listener: {name!r} "
+                           f"(loaded: {sorted(self._listener_factories)})")
+        return self._listener_factories[name](config or {})
+
     def create_catalog(self, catalog_name: str, connector_name: str,
                        config: Optional[dict] = None) -> Connector:
         """CREATE CATALOG equivalent (reference:

@@ -98,6 +98,7 @@ _SPECIAL_FUNCTIONS = {
     "coalesce", "if", "mod", "nullif", "grouping", "greatest", "least",
     "sign", "date_trunc", "cardinality", "element_at", "contains",
     "array_position", "approx_distinct", "count_if", "geometric_mean",
+    "json_extract", "json_extract_scalar", "json_array_length",
 }
 
 
@@ -696,6 +697,15 @@ class Translator:
             pa, pb = self._promote_pair(a, b)
             return Call(a.type, "$if",
                         (Call(BOOLEAN, "eq", (pa, pb)), Literal(a.type, None), a))
+        if name in ("json_extract", "json_extract_scalar",
+                    "json_array_length"):
+            a = self.translate(e.args[0])
+            if not is_string(a.type):
+                raise AnalysisError(f"{name} requires a varchar argument")
+            if name == "json_array_length":
+                return Call(BIGINT, name, (a,))
+            return Call(VARCHAR, name,
+                        (a, cast_to(self.translate(e.args[1]), VARCHAR)))
         if name in ("cardinality", "element_at", "contains", "array_position"):
             a = self.translate(e.args[0])
             if not isinstance(a.type, ArrayType):

@@ -126,3 +126,45 @@ def test_fromless_scalars(harness):
         "select round(pi(), 4), round(e(), 4), round(degrees(pi()), 1), "
         "truncate(2.71), round(cbrt(27.0), 6), log2(8)").rows()
     assert [float(x) for x in rows[0]] == [3.1416, 2.7183, 180.0, 2.0, 3.0, 3.0]
+
+
+def test_string_breadth_literals(harness):
+    """split_part/lpad/rpad/repeat/translate/codepoint/position (no sqlite
+    equivalents; literal expectations; reference: operator/scalar/
+    StringFunctions)."""
+    runner, dist, _ = harness
+    sql = ("select split_part('a-b-c', '-', 2), lpad('x', 4, '*'), "
+           "rpad('x', 3, 'ab'), repeat('ab', 3), "
+           "translate('hello', 'el', 'ip'), codepoint('A')")
+    expect = [("b", "***x", "xab", "ababab", "hippo", 65)]
+    assert runner.execute(sql).rows() == expect
+    assert dist.execute(sql).rows() == expect
+    assert runner.execute(
+        "select n_name from nation where split_part(n_name, ' ', 1) = 'UNITED' "
+        "order by 1").rows() == [("UNITED KINGDOM",), ("UNITED STATES",)]
+    # truncation + 1-based position semantics
+    assert runner.execute(
+        "select lpad('abcdef', 3), position('AN', n_name) from nation "
+        "where n_name = 'CANADA'").rows() == [("abc", 2)]
+
+
+def test_string_breadth_trino_semantics(harness):
+    runner, _, _ = harness
+    import pytest as _pytest
+
+    # split_part: NULL past the last field; empty delimiter rejected
+    assert runner.execute(
+        "select split_part('a-b', '-', 3)").rows() == [(None,)]
+    with _pytest.raises(Exception):
+        runner.execute("select split_part('abc', '', 1)")
+    # translate: first duplicate wins
+    assert runner.execute(
+        "select translate('a', 'aa', 'bc')").rows() == [("b",)]
+    # pad: negative size / empty fill rejected
+    with _pytest.raises(Exception):
+        runner.execute("select lpad('abc', -2, '*')")
+    with _pytest.raises(Exception):
+        runner.execute("select rpad('x', 5, '')")
+    # codepoint: NULL unless exactly one character
+    assert runner.execute(
+        "select codepoint('AB'), codepoint('A')").rows() == [(None, 65)]

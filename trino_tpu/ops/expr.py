@@ -614,6 +614,86 @@ def _starts_with_handler(out_type, args):
     return _and_extra_valid(Lowered(BOOLEAN, None, fn), args[1:])
 
 
+def _split_part_handler(out_type, args):
+    col = args[0]
+    delim = _literal_str(args[1])
+    idx = _literal_int(args[2])
+    if col.dictionary is None:
+        raise NotImplementedError("split_part on non-dictionary column")
+    if not delim:
+        raise ValueError("split_part: delimiter must not be empty")
+    if idx < 1:
+        raise ValueError("split_part: index must be >= 1")
+
+    def fn(s: str):
+        parts = str(s).split(delim)
+        # Trino: NULL when the index exceeds the number of fields
+        return parts[idx - 1] if idx <= len(parts) else None
+
+    return _and_extra_valid(
+        _array_table_lookup(col, [fn(v) for v in col.dictionary], VARCHAR),
+        args[1:])
+
+
+def _pad_handler(left: bool):
+    def handler(out_type, args):
+        col = args[0]
+        size = _literal_int(args[1])
+        fill = _literal_str(args[2]) if len(args) > 2 else " "
+        if col.dictionary is None:
+            raise NotImplementedError("pad on non-dictionary column")
+        if size < 0:
+            raise ValueError("pad: target size must not be negative")
+        if not fill:
+            raise ValueError("pad: padding string must not be empty")
+
+        def fn(s: str) -> str:
+            if len(s) >= size:
+                return s[:size]
+            pad = (fill * size)[: size - len(s)]
+            return pad + s if left else s + pad
+
+        return _and_extra_valid(_dict_transform(col, fn, VARCHAR), args[1:])
+
+    return handler
+
+
+def _repeat_str_handler(out_type, args):
+    col = args[0]
+    n = _literal_int(args[1])
+    if col.dictionary is None:
+        raise NotImplementedError("repeat on non-dictionary column")
+    return _and_extra_valid(
+        _dict_transform(col, lambda s: s * max(n, 0), VARCHAR), args[1:])
+
+
+def _translate_handler(out_type, args):
+    col = args[0]
+    src = _literal_str(args[1])
+    dst = _literal_str(args[2])
+    if col.dictionary is None:
+        raise NotImplementedError("translate on non-dictionary column")
+    table: dict = {}
+    for i, a in enumerate(src):  # first duplicate wins (Trino semantics)
+        table.setdefault(ord(a), dst[i] if i < len(dst) else None)
+    return _and_extra_valid(
+        _dict_transform(col, lambda s: s.translate(table), VARCHAR),
+        args[1:])
+
+
+def _codepoint_handler(out_type, args):
+    col = args[0]
+    if col.dictionary is None:
+        raise NotImplementedError("codepoint on non-dictionary column")
+    # Trino errors unless the input is exactly one character; dictionary
+    # entries are evaluated eagerly (rows may never select a bad entry), so
+    # the faithful per-row error degrades to NULL here
+    return _array_table_lookup(
+        col,
+        [ord(str(v)) if len(str(v)) == 1 else None for v in col.dictionary],
+        BIGINT)
+
+
 def _variadic_minmax(jfn):
     """greatest/least: NULL if any argument is NULL (Trino semantics)."""
 
@@ -973,6 +1053,12 @@ HANDLERS: dict[str, Callable] = {
     "replace": _replace_handler,
     "strpos": _strpos_handler,
     "starts_with": _starts_with_handler,
+    "split_part": _split_part_handler,
+    "lpad": _pad_handler(left=True),
+    "rpad": _pad_handler(left=False),
+    "repeat": _repeat_str_handler,
+    "translate": _translate_handler,
+    "codepoint": _codepoint_handler,
     "greatest": _variadic_minmax(jnp.maximum),
     "least": _variadic_minmax(jnp.minimum),
     "sign": _elementwise(jnp.sign),

@@ -86,6 +86,9 @@ _SCALAR_TYPES: dict[str, str] = {
     "reverse": "varchar", "concat": "varchar", "replace": "varchar",
     "starts_with": "boolean", "is_nan": "boolean",
     "truncate": "arg",
+    "split_part": "varchar", "lpad": "varchar", "rpad": "varchar",
+    "repeat": "varchar", "translate": "varchar",
+    "codepoint": "bigint",
     "cbrt": "double", "degrees": "double", "radians": "double",
     "sin": "double", "cos": "double", "tan": "double",
     "asin": "double", "acos": "double", "atan": "double", "atan2": "double",
@@ -98,7 +101,7 @@ _SPECIAL_FUNCTIONS = {
     "coalesce", "if", "mod", "nullif", "grouping", "greatest", "least",
     "sign", "date_trunc", "cardinality", "element_at", "contains",
     "array_position", "approx_distinct", "count_if", "geometric_mean",
-    "json_extract", "json_extract_scalar", "json_array_length",
+    "json_extract", "json_extract_scalar", "json_array_length", "position",
 }
 
 
@@ -592,6 +595,11 @@ class Translator:
             out_t = agg_result_type(name, arg.type)
             idx = self.aggregates.add(name, arg, e.distinct, out_t)
             return Call(out_t, "$aggref", (Literal(BIGINT, idx),))
+        if name == "position":
+            # position(needle, haystack) = strpos(haystack, needle)
+            a = self.translate(e.args[0])
+            b = self.translate(e.args[1])
+            return Call(BIGINT, "strpos", (b, a))
         if name == "coalesce":
             return self._t_coalesce(e)
         if name == "grouping":

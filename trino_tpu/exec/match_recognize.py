@@ -293,7 +293,9 @@ class MatchRecognizeOperator(BufferedInputMixin, Operator):
             out = []
             for c, asc in self.order_keys:
                 v = rows[i][self.input_names[c]]
-                out.append((v is None, v if asc else _Desc(v)))
+                # ASC defaults NULLS LAST, DESC defaults NULLS FIRST
+                out.append((v is None if asc else v is not None,
+                            v if asc else _Desc(v)))
             return tuple(out)
 
         idx = sorted(range(len(rows)), key=lambda i: (pkey(i), okey(i)))

@@ -203,8 +203,16 @@ def _cmp_dict_literal(name: str, col: Lowered, lit_value: str):
     """Compare dictionary codes against a string literal using only the
     host-side sorted dictionary (order-correct by construction)."""
     d = col.dictionary
-    lo = int(np.searchsorted(d, lit_value, side="left"))
-    hi = int(np.searchsorted(d, lit_value, side="right"))
+    if isinstance(lit_value, tuple):
+        # array dictionary: numpy would treat a tuple needle as an array of
+        # elements, and entries sort by _canon_key not raw order — linear
+        # scan (only eq/ne reach here for arrays; dictionaries are small)
+        hits = [i for i, v in enumerate(d) if v == lit_value]
+        lo = hits[0] if hits else 0
+        hi = lo + 1 if hits else 0
+    else:
+        lo = int(np.searchsorted(d, lit_value, side="left"))
+        hi = int(np.searchsorted(d, lit_value, side="right"))
     present = lo < hi
 
     def fn(cols: Cols):
@@ -229,13 +237,21 @@ def _cmp_dict_literal(name: str, col: Lowered, lit_value: str):
 def _cmp_handler(name: str):
     def handler(out_type: Type, args: list[Lowered]) -> Lowered:
         a, b = args
-        if is_string(a.type) or is_string(b.type):
+        is_arr = isinstance(a.type, ArrayType) or isinstance(b.type, ArrayType)
+        if is_arr and name not in ("eq", "ne"):
+            raise NotImplementedError("array ordering comparison")
+        if is_string(a.type) or is_string(b.type) or is_arr:
+            # array dictionaries hold python tuples — comparable/sortable
+            # like strings, but never coerced through str()
+            def lit(d):
+                return d[0] if is_arr else str(d[0])
+
             # literal vs column: route through the sorted dictionary
             if b.dictionary is not None and len(b.dictionary) == 1 and a.dictionary is not None and len(a.dictionary) != 1:
-                return _cmp_dict_literal(name, a, str(b.dictionary[0]))
+                return _cmp_dict_literal(name, a, lit(b.dictionary))
             if a.dictionary is not None and len(a.dictionary) == 1 and b.dictionary is not None and len(b.dictionary) != 1:
                 flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
-                return _cmp_dict_literal(flip[name], b, str(a.dictionary[0]))
+                return _cmp_dict_literal(flip[name], b, lit(a.dictionary))
             if _dicts_equal(a.dictionary, b.dictionary):
                 pass  # codes comparable directly (sorted dictionary)
             elif name in ("eq", "ne") and a.dictionary is not None and b.dictionary is not None:
@@ -658,13 +674,37 @@ def _pad_handler(left: bool):
     return handler
 
 
-def _repeat_str_handler(out_type, args):
+def _repeat_handler(out_type, args):
+    """repeat(element, count) -> array(T) (reference:
+    operator/scalar/RepeatFunction.java).  Element dictionaries (varchar /
+    array) transform entry-wise; literal scalars build a one-entry constant
+    array dictionary."""
     col = args[0]
-    n = _literal_int(args[1])
-    if col.dictionary is None:
-        raise NotImplementedError("repeat on non-dictionary column")
-    return _and_extra_valid(
-        _dict_transform(col, lambda s: s * max(n, 0), VARCHAR), args[1:])
+    n = max(_literal_int(args[1]), 0)
+    if col.dictionary is not None:
+        vals = np.empty(len(col.dictionary), dtype=object)
+        for i, v in enumerate(col.dictionary):
+            elem = v if isinstance(v, tuple) else str(v)
+            vals[i] = (elem,) * n
+        newdict, remap = np.unique(vals, return_inverse=True)
+        remap = remap.astype(np.int32)
+
+        def fn(cols: Cols):
+            codes, valid = col.fn(cols)
+            return jnp.asarray(remap)[codes], valid
+
+        return _and_extra_valid(Lowered(out_type, newdict, fn), args[1:])
+    if hasattr(col.fn, "_literal_value"):
+        newdict = np.empty(1, dtype=object)
+        newdict[0] = (col.fn._literal_value,) * n
+
+        def fn(cols: Cols):
+            _, valid = col.fn(cols)
+            return jnp.zeros((), dtype=jnp.int32), valid
+
+        return _and_extra_valid(Lowered(out_type, newdict, fn), args[1:])
+    raise NotImplementedError("repeat element must be a dictionary column "
+                              "or literal")
 
 
 def _translate_handler(out_type, args):
@@ -1056,7 +1096,7 @@ HANDLERS: dict[str, Callable] = {
     "split_part": _split_part_handler,
     "lpad": _pad_handler(left=True),
     "rpad": _pad_handler(left=False),
-    "repeat": _repeat_str_handler,
+    "repeat": _repeat_handler,
     "translate": _translate_handler,
     "codepoint": _codepoint_handler,
     "greatest": _variadic_minmax(jnp.maximum),

@@ -18,10 +18,18 @@ from typing import Optional
 
 from ..spi.batch import _to_days, _to_micros, _to_scaled_int
 from ..spi.predicate import Domain, Range, TupleDomain, ValueSet
-from ..spi.types import DATE, TIMESTAMP, DecimalType, Type, is_string
+from ..spi.types import DATE, TIMESTAMP, ArrayType, DecimalType, Type, is_string
 from ..sql.ir import Call, InputRef, Literal, RowExpression
 
 __all__ = ["extract_tuple_domain", "storage_value"]
+
+
+def _domain_comparable(t: Type) -> bool:
+    """Only scalar types participate in Domain ranges.  Array (and other
+    nested) literals would put python tuples into Ranges that zone-map stats
+    then compare against stringified dictionary entries — bail out so those
+    predicates stay in the exact Filter."""
+    return not isinstance(t, ArrayType)
 
 
 def storage_value(t: Type, v):
@@ -44,9 +52,9 @@ def storage_value(t: Type, v):
 def _column_literal(c: Call) -> Optional[tuple[InputRef, object, bool]]:
     """Match (InputRef, Literal) or (Literal, InputRef); bool = flipped."""
     a, b = c.args
-    if isinstance(a, InputRef) and isinstance(b, Literal):
+    if isinstance(a, InputRef) and isinstance(b, Literal) and _domain_comparable(a.type):
         return a, storage_value(a.type, b.value), False
-    if isinstance(b, InputRef) and isinstance(a, Literal):
+    if isinstance(b, InputRef) and isinstance(a, Literal) and _domain_comparable(b.type):
         return b, storage_value(b.type, a.value), True
     return None
 
@@ -79,7 +87,7 @@ def _conjunct_domain(c: RowExpression) -> Optional[tuple[int, Domain]]:
         return ref.index, Domain(ValueSet((Range(v, True, None, False),)), False)
     if name == "$in":
         col = c.args[0]
-        if not isinstance(col, InputRef):
+        if not isinstance(col, InputRef) or not _domain_comparable(col.type):
             return None
         vals = []
         for a in c.args[1:]:

@@ -87,7 +87,7 @@ _SCALAR_TYPES: dict[str, str] = {
     "starts_with": "boolean", "is_nan": "boolean",
     "truncate": "arg",
     "split_part": "varchar", "lpad": "varchar", "rpad": "varchar",
-    "repeat": "varchar", "translate": "varchar",
+    "translate": "varchar",
     "codepoint": "bigint",
     "cbrt": "double", "degrees": "double", "radians": "double",
     "sin": "double", "cos": "double", "tan": "double",
@@ -102,6 +102,7 @@ _SPECIAL_FUNCTIONS = {
     "sign", "date_trunc", "cardinality", "element_at", "contains",
     "array_position", "approx_distinct", "count_if", "geometric_mean",
     "json_extract", "json_extract_scalar", "json_array_length", "position",
+    "repeat",
 }
 
 
@@ -714,6 +715,13 @@ class Translator:
                 return Call(BIGINT, name, (a,))
             return Call(VARCHAR, name,
                         (a, cast_to(self.translate(e.args[1]), VARCHAR)))
+        if name == "repeat":
+            # repeat(element, count) -> array(T)
+            # (reference: operator/scalar/RepeatFunction.java — NOT a string
+            # repetition; Trino has no string repeat)
+            a = self.translate(e.args[0])
+            b = self.translate(e.args[1])
+            return Call(ArrayType(a.type), "repeat", (a, cast_to(b, BIGINT)))
         if name in ("cardinality", "element_at", "contains", "array_position"):
             a = self.translate(e.args[0])
             if not isinstance(a.type, ArrayType):

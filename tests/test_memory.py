@@ -54,7 +54,8 @@ def test_revocation_evicts_device_batches_to_host():
     assert host >= 1
     op.finish_input()
     out = op.get_output()
-    assert out.num_rows == 20 * 1024  # nothing lost
+    # device sort emits a bucket-padded batch; live rows carry the data
+    assert out.live_count == 20 * 1024  # nothing lost
 
 
 def test_disk_spill_tier():

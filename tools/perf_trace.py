@@ -1,0 +1,126 @@
+"""Host-sync / dispatch profiler for engine queries on the tunneled TPU.
+
+Counts and times every blocking device interaction (jax.device_get,
+ArrayImpl.__array__ pulls, scalar int()/bool() syncs) plus jit dispatches,
+attributed to call sites.  Usage:
+
+    python tools/perf_trace.py [--sf 0.05] [--queries q1,q3]
+
+Each blocking RPC through the axon tunnel costs ~120ms; the point of the
+round-4 perf work is to drive these counts to ~1 scalar sync per blocking
+operator and zero bulk D2H on the hot path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import time
+import traceback
+
+import jax
+import numpy as np
+
+STATS = collections.defaultdict(lambda: [0, 0.0, 0])  # site -> [count, secs, bytes]
+ENABLED = {"on": False}
+
+
+def _site() -> str:
+    for fr in reversed(traceback.extract_stack(limit=25)):
+        fn = fr.filename
+        if "/trino_tpu/" in fn:
+            return f"{fn.split('/trino_tpu/')[-1]}:{fr.lineno}"
+    return "external"
+
+
+def _wrap(obj, name, kind):
+    orig = getattr(obj, name)
+
+    def wrapper(*a, **kw):
+        if not ENABLED["on"]:
+            return orig(*a, **kw)
+        t0 = time.perf_counter()
+        out = orig(*a, **kw)
+        dt = time.perf_counter() - t0
+        s = STATS[(kind, _site())]
+        s[0] += 1
+        s[1] += dt
+        try:
+            if kind == "device_get":
+                leaves = jax.tree_util.tree_leaves(out)
+                s[2] += sum(getattr(x, "nbytes", 0) for x in leaves)
+            elif kind == "to_np":
+                s[2] += getattr(out, "nbytes", 0)
+        except Exception:
+            pass
+        return out
+
+    setattr(obj, name, wrapper)
+
+
+def install() -> None:
+    from jax._src.array import ArrayImpl
+
+    _wrap(jax, "device_get", "device_get")
+    _wrap(ArrayImpl, "__array__", "to_np")
+    _wrap(ArrayImpl, "__int__", "scalar")
+    _wrap(ArrayImpl, "__bool__", "scalar")
+    _wrap(ArrayImpl, "__float__", "scalar")
+    _wrap(ArrayImpl, "__index__", "scalar")
+    _wrap(ArrayImpl, "block_until_ready", "block")
+    import jax._src.pjit as _pjit
+
+    if hasattr(_pjit, "_python_pjit_helper"):
+        _wrap(_pjit, "_python_pjit_helper", "jit_call")
+
+
+def report(title: str) -> None:
+    print(f"\n== {title} ==")
+    rows = sorted(STATS.items(), key=lambda kv: -kv[1][1])
+    total_t = sum(v[1] for v in STATS.values())
+    total_n = sum(v[0] for v in STATS.values())
+    for (kind, site), (n, secs, nbytes) in rows[:30]:
+        mb = f" {nbytes / 1e6:8.1f}MB" if nbytes else "           "
+        print(f"  {secs * 1e3:8.1f}ms  n={n:<5d}{mb}  {kind:10s} {site}")
+    print(f"  TOTAL blocking+dispatch: {total_t * 1e3:.1f}ms over {total_n} events")
+    STATS.clear()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.05)
+    ap.add_argument("--queries", default="q1,q3")
+    args = ap.parse_args()
+
+    install()
+
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    bench._enable_compile_cache()
+    catalog = bench._stage_memory_tables(args.sf)
+    from trino_tpu.runner import Session, StandaloneQueryRunner
+
+    runner = StandaloneQueryRunner(
+        catalog, session=Session(default_catalog="memory", splits_per_node=1))
+
+    for name in args.queries.split(","):
+        sql = bench.QUERIES[name]
+        runner.execute(sql)  # warmup/compile
+        STATS.clear()
+        ENABLED["on"] = True
+        t0 = time.perf_counter()
+        r = runner.execute(sql)
+        for c in r.batch.columns:
+            jax.block_until_ready(c.data)
+        wall = time.perf_counter() - t0
+        ENABLED["on"] = False
+        print(f"\n### {name}: wall {wall * 1e3:.1f}ms")
+        report(name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Blocking per-phase timer: wraps the engine's jitted entry points with
+block_until_ready so device time is attributed to the program that spent it
+(the async dispatch model otherwise charges everything to the next sync)."""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+PHASES = collections.defaultdict(lambda: [0, 0.0])
+
+
+def timed(name, fn):
+    def wrapper(*a, **kw):
+        t0 = time.perf_counter()
+        out = fn(*a, **kw)
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+        dt = time.perf_counter() - t0
+        s = PHASES[name]
+        s[0] += 1
+        s[1] += dt
+        return out
+
+    return wrapper
+
+
+def main() -> None:
+    sf = float(os.environ.get("SF", "0.2"))
+    import bench
+
+    bench._enable_compile_cache()
+
+    import trino_tpu.exec.join_exec as JX
+    import trino_tpu.exec.kernels as K
+    from trino_tpu.exec.operators import FilterProjectOperator
+
+    for mod, name in [(JX, "_build_fn"), (JX, "_ranges_fn")]:
+        orig = getattr(mod, name)
+
+        def make(orig, label):
+            def cached(*a, **kw):
+                return timed(label, orig(*a, **kw))
+
+            return cached
+
+        setattr(mod, name, make(orig, name))
+
+    # pair programs
+    orig_make_pair = JX._make_pair_fn
+
+    def make_pair(*a, **kw):
+        return timed("pair_program", orig_make_pair(*a, **kw))
+
+    JX._make_pair_fn = make_pair
+    JX._PAIR_CACHE.clear()
+
+    for name in ["_group_ids_fn", "_reduce_fn", "_keys_out_fn",
+                 "_finalize_fn", "_device_sort_fn", "_domain_fn"]:
+        orig = getattr(K, name)
+
+        def mk(orig, label):
+            def cached(*a, **kw):
+                return timed(label, orig(*a, **kw))
+
+            return cached
+
+        setattr(K, name, mk(orig, name))
+
+    orig_compile = FilterProjectOperator._compile
+
+    def compile_wrap(self, batch):
+        run, projs = orig_compile(self, batch)
+        return timed("filter_project", run), projs
+
+    FilterProjectOperator._compile = compile_wrap
+
+    catalog = bench._stage_memory_tables(sf)
+    from trino_tpu.runner import Session, StandaloneQueryRunner
+
+    runner = StandaloneQueryRunner(
+        catalog, session=Session(default_catalog="memory", splits_per_node=1))
+
+    for qname in os.environ.get("QUERIES", "q1,q3").split(","):
+        sql = bench.QUERIES[qname]
+        runner.execute(sql)  # warmup
+        PHASES.clear()
+        t0 = time.perf_counter()
+        r = runner.execute(sql)
+        for c in r.batch.columns:
+            jax.block_until_ready(c.data)
+        wall = time.perf_counter() - t0
+        print(f"\n### {qname}: wall {wall * 1e3:.1f}ms")
+        for name, (n, secs) in sorted(PHASES.items(), key=lambda kv: -kv[1][1]):
+            print(f"  {secs * 1e3:8.1f}ms  n={n:<4d} {name}")
+
+
+if __name__ == "__main__":
+    main()

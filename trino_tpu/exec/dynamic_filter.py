@@ -37,6 +37,50 @@ class DynamicFilterHolder:
         self.has_nan = False  # build had NaN keys (NaN joins NaN here)
         self.rows_pruned = 0  # observability: how many probe rows we dropped
 
+    def fill_device(self, data, valid, live,
+                    dictionary: Optional[np.ndarray]) -> None:
+        """Device-resident build keys: derive the domain with ONE jitted
+        program + one small device_get instead of pulling the key column to
+        host (the round-3 fill cost a full D2H of the build keys).  Builds
+        small enough for an exact value set (<= MAX_DISTINCT_SET rows) pull
+        the keys in one round trip and keep :meth:`fill`'s exact-set
+        pruning; larger builds degrade to min/max range (+ dictionary
+        presence for string keys)."""
+        import jax
+
+        n = int(data.shape[0])
+        host_like = isinstance(data, np.ndarray) and (
+            valid is None or isinstance(valid, np.ndarray)) and (
+            live is None or isinstance(live, np.ndarray))
+        if host_like or (n <= MAX_DISTINCT_SET and dictionary is None):
+            data, valid, live = jax.device_get((data, valid, live))
+            if live is not None:
+                keep = np.asarray(live)
+                data = np.asarray(data)[keep]
+                valid = None if valid is None else np.asarray(valid)[keep]
+            self.fill(np.asarray(data), valid, dictionary)
+            return
+        import jax.numpy as jnp
+
+        from .kernels import _device_domain
+
+        dict_len = len(dictionary) if dictionary is not None else 0
+        out = jax.device_get(_device_domain(data, valid, live, dict_len))
+        cnt, cnt_nonnan, vmin, vmax, presence = out
+        if int(cnt) == 0:
+            self.empty = True
+            self.ready = True
+            return
+        if dictionary is not None:
+            self.dict_values = set(
+                str(v) for v in dictionary[np.asarray(presence)])
+        else:
+            self.has_nan = int(cnt_nonnan) < int(cnt)
+            if int(cnt_nonnan) > 0:
+                self.vmin = vmin
+                self.vmax = vmax
+        self.ready = True
+
     def fill(self, data: np.ndarray, valid: Optional[np.ndarray],
              dictionary: Optional[np.ndarray]) -> None:
         data = np.asarray(data)

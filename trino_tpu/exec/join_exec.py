@@ -1,0 +1,384 @@
+"""Device-resident hash-join execution programs.
+
+Round-4 rework of the join hot path (reference: operator/join/
+LookupJoinOperator.java:37, HashBuilderOperator.java:57, PagesHash).  The
+round-3 engine pulled every (probe_idx, build_idx) match pair to the host
+(`jax.device_get` of megarow int64 arrays through a 10-80 MB/s tunnel) and
+re-uploaded them for gathers; this module keeps the whole probe on device:
+
+- ``build_table``: ONE jitted program hashes + sorts the build keys
+  (``hash_combine`` + argsort on chip); one 2-scalar device_get fetches
+  (has_null_key, live_rows) for planner-visible semantics.
+- ``probe_ranges``: ONE jitted program computes candidate ranges via binary
+  search in the sorted hash; ONE scalar sync fetches the total candidate
+  count (needed to pick the static expansion bucket — the only data-
+  dependent shape in the join).
+- ``run_pairs``: ONE jitted program per (join shape, residual, bucket)
+  expands candidates, verifies key equality exactly (hash candidates ->
+  per-key compare, NaN=NaN), evaluates the residual predicate, gathers ALL
+  output columns at the matched pairs, and computes per-probe matched flags
+  for LEFT/SINGLE and the semi-join mark — outputs stay on device as a
+  ``live``-masked batch.
+
+Total blocking host interaction per probe batch: one scalar sync.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.expr import compile_expression
+from ..sql.ir import RowExpression
+from . import kernels as K
+
+__all__ = ["DeviceJoinTable", "build_table", "probe_ranges", "run_pairs"]
+
+_SENT_BUILD = 0xFFFFFFFFFFFFFFFF  # build rows with NULL keys / dead rows
+_SENT_PROBE = 0xFFFFFFFFFFFFFFFE  # probe rows with NULL keys
+
+
+class DeviceJoinTable:
+    """Sorted-hash build side, all arrays device-resident."""
+
+    __slots__ = ("sorted_hash", "perm", "key_datas", "has_null_key",
+                 "num_rows", "live_rows")
+
+    def __init__(self, sorted_hash, perm, key_datas,
+                 has_null_key: bool, num_rows: int, live_rows: int):
+        self.sorted_hash = sorted_hash
+        self.perm = perm
+        self.key_datas = key_datas  # unsorted, for exact verify
+        self.has_null_key = has_null_key  # among LIVE rows
+        self.num_rows = num_rows  # physical slots (incl. dead padding)
+        self.live_rows = live_rows
+
+
+@lru_cache(maxsize=None)
+def _build_fn(num_keys: int, has_valid: tuple, has_live: bool):
+    @jax.jit
+    def fn(*flat):
+        i = 0
+        datas, valids = [], []
+        for k in range(num_keys):
+            datas.append(flat[i])
+            i += 1
+            if has_valid[k]:
+                valids.append(flat[i])
+                i += 1
+            else:
+                valids.append(None)
+        live = flat[i] if has_live else None
+        h = K.hash_combine(datas)
+        null_mask = None
+        for v in valids:
+            if v is not None:
+                nm = ~v
+                null_mask = nm if null_mask is None else (null_mask | nm)
+        n = datas[0].shape[0]
+        live_rows = (jnp.asarray(n, jnp.int64) if live is None
+                     else jnp.sum(live))
+        if null_mask is not None:
+            has_null = jnp.any(null_mask if live is None
+                               else (null_mask & live))
+            h = jnp.where(null_mask, jnp.uint64(_SENT_BUILD), h)
+        else:
+            has_null = jnp.asarray(False)
+        if live is not None:
+            h = jnp.where(live, h, jnp.uint64(_SENT_BUILD))
+        perm = jnp.argsort(h)
+        return h[perm], perm, has_null, live_rows
+
+    return fn
+
+
+def build_table(keys: Sequence[tuple], live=None,
+                num_rows: Optional[int] = None) -> DeviceJoinTable:
+    """keys: [(data, valid|None), ...]; ``live`` masks dead (padded) build
+    rows — they never match and don't count toward live_rows/has_null."""
+    if not keys:  # cross join: every probe row pairs with every live row
+        n = int(num_rows or 0)
+        lr = n
+        if live is not None:
+            lr = int(np.asarray(jnp.sum(jnp.asarray(live))))
+        return DeviceJoinTable(None, None, [], False, n, lr)
+    has_valid = tuple(v is not None for _, v in keys)
+    flat: list = []
+    datas = []
+    for (d, v), hv in zip(keys, has_valid):
+        d = jnp.asarray(d)
+        datas.append(d)
+        flat.append(d)
+        if hv:
+            flat.append(jnp.asarray(v))
+    if live is not None:
+        flat.append(jnp.asarray(live))
+    sh, perm, has_null, live_rows = _build_fn(
+        len(keys), has_valid, live is not None)(*flat)
+    # one round trip for both planner-visible scalars
+    has_null_h, live_rows_h = jax.device_get((has_null, live_rows))
+    return DeviceJoinTable(sh, perm, datas, bool(has_null_h),
+                           int(datas[0].shape[0]), int(live_rows_h))
+
+
+@lru_cache(maxsize=None)
+def _ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
+               has_remap: tuple):
+    @jax.jit
+    def fn(sorted_hash, *flat):
+        i = 0
+        datas, valids = [], []
+        for k in range(num_keys):
+            d = flat[i]
+            i += 1
+            if has_remap[k]:
+                d = flat[i][d]  # dictionary remap table gather
+                i += 1
+            datas.append(d)
+            if has_valid[k]:
+                valids.append(flat[i])
+                i += 1
+            else:
+                valids.append(None)
+        live = flat[i] if has_live else None
+        h = K.hash_combine(datas)
+        pnull = None
+        for k, v in enumerate(valids):
+            nm = ~v if v is not None else None
+            if has_remap[k]:
+                # remapped code -1 = value absent from the build dictionary:
+                # cannot match (but is NOT a null probe for null-aware marks)
+                miss = datas[k] < 0
+                nm = miss if nm is None else (nm | miss)
+            if nm is not None:
+                pnull = nm if pnull is None else (pnull | nm)
+        if pnull is not None:
+            h = jnp.where(pnull, jnp.uint64(_SENT_PROBE), h)
+        lo = K.searchsorted(sorted_hash, h, side="left")
+        hi = K.searchsorted(sorted_hash, h, side="right")
+        counts = hi - lo
+        if pnull is not None:
+            counts = jnp.where(pnull, 0, counts)
+        if live is not None:
+            counts = jnp.where(live, counts, 0)
+        # the build sentinel region (null/dead rows) must never match, and
+        # null probes must not hit it
+        counts = jnp.where(h >= jnp.uint64(_SENT_PROBE), 0, counts)
+        return lo, counts, jnp.sum(counts)
+
+    return fn
+
+
+def probe_ranges(table: DeviceJoinTable, probe_keys: Sequence[tuple],
+                 remaps: Sequence[Optional[np.ndarray]], live=None):
+    """probe_keys: [(data, valid|None), ...]; ``remaps[k]`` an optional
+    host int32 table translating probe dictionary codes into the build code
+    space (-1 = value absent).  Returns (lo, counts, total:int) with
+    lo/counts on device — ONE host scalar sync."""
+    has_valid = tuple(v is not None for _, v in probe_keys)
+    has_remap = tuple(r is not None for r in remaps)
+    flat: list = [table.sorted_hash]
+    for (d, v), r in zip(probe_keys, remaps):
+        flat.append(jnp.asarray(d))
+        if r is not None:
+            flat.append(jnp.asarray(r))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    if live is not None:
+        flat.append(jnp.asarray(live))
+    lo, counts, total = _ranges_fn(
+        len(probe_keys), has_valid, live is not None, has_remap)(*flat)
+    return lo, counts, int(total)
+
+
+# ---------------------------------------------------------------------------
+# pair expansion + verify + residual + output gather: one program
+
+_PAIR_CACHE: dict = {}
+_PAIR_LOCK = threading.Lock()
+
+
+def _make_pair_fn(cap: int, num_keys: int, has_pvalid: tuple,
+                  has_remap: tuple, pair_types, pair_dicts,
+                  n_probe_cols: int, n_build_cols: int,
+                  pcol_has_valid: tuple, bcol_has_valid: tuple,
+                  residual: Optional[RowExpression],
+                  need_matched: bool, semi: Optional[tuple]):
+    """Build the pair program.  Flat operand order:
+    lo, counts, total, perm,
+    per probe key: data [remap] [valid],
+    per probe col: data [valid],
+    per build col: data [valid],
+    build key datas.
+
+    ``semi``: None for a regular join; (null_aware, has_null_build,
+    build_nonempty) for the semi-join mark variant (outputs (mark, valid)
+    instead of gathered pair columns)."""
+    res_fn = (compile_expression(residual, list(pair_types), list(pair_dicts))
+              if residual is not None else None)
+
+    def fn(lo, counts, total, perm, *flat):
+        i = 0
+        pkeys, pkvalids = [], []
+        for k in range(num_keys):
+            d = flat[i]
+            i += 1
+            if has_remap[k]:
+                d = flat[i][d]
+                i += 1
+            pkeys.append(d)
+            if has_pvalid[k]:
+                pkvalids.append(flat[i])
+                i += 1
+            else:
+                pkvalids.append(None)
+        pcols = []
+        for c in range(n_probe_cols):
+            d = flat[i]
+            i += 1
+            v = None
+            if pcol_has_valid[c]:
+                v = flat[i]
+                i += 1
+            pcols.append((d, v))
+        bcols = []
+        for c in range(n_build_cols):
+            d = flat[i]
+            i += 1
+            v = None
+            if bcol_has_valid[c]:
+                v = flat[i]
+                i += 1
+            bcols.append((d, v))
+        bkeys = list(flat[i:i + num_keys])
+
+        n_probe = pkeys[0].shape[0] if pkeys else (
+            pcols[0][0].shape[0] if pcols else 1)
+        nb = perm.shape[0]
+        ends = jnp.cumsum(counts)
+        starts = ends - counts
+        slot = jnp.arange(cap)
+        probe_id = jnp.clip(
+            K.searchsorted(ends, slot, side="right"), 0, n_probe - 1)
+        within = slot - starts[probe_id]
+        build_pos = lo[probe_id] + within
+        build_id = perm[jnp.clip(build_pos, 0, nb - 1)]
+        ok = slot < total
+        for pk, bk in zip(pkeys, bkeys):
+            ok = ok & ~K._neq(pk[probe_id], bk[build_id])
+
+        pairs = None
+        if semi is None or res_fn is not None:
+            pairs = [(d[probe_id], None if v is None else v[probe_id])
+                     for d, v in pcols]
+            pairs += [(d[build_id], None if v is None else v[build_id])
+                      for d, v in bcols]
+        if res_fn is not None:
+            rd, rv = res_fn(pairs)
+            rmask = rd if rv is None else (rd & rv)
+            if getattr(rmask, "ndim", 1) == 0:
+                rmask = jnp.broadcast_to(rmask, (cap,))
+            ok = ok & rmask
+
+        matched = None
+        max_per_probe = None
+        if need_matched or semi is not None:
+            # per-probe match count: pairs are sorted by probe_id, so the
+            # count is a prefix-sum difference at segment boundaries
+            # (scatters serialize on TPU; this is all gathers)
+            cs = jnp.cumsum(ok.astype(jnp.int64))
+            pr = jnp.arange(n_probe)
+            pend = K.searchsorted(probe_id, pr, side="right")
+            pstart = K.searchsorted(probe_id, pr, side="left")
+            hi2 = cs[jnp.maximum(pend - 1, 0)]
+            lo2 = jnp.where(pstart > 0, cs[jnp.maximum(pstart - 1, 0)],
+                            jnp.zeros((), jnp.int64))
+            cnt = jnp.where(pend > pstart, hi2 - lo2, 0)
+            matched = cnt > 0
+            max_per_probe = jnp.max(cnt)
+
+        if semi is not None:
+            # three-valued NOT IN: a non-match is UNKNOWN (NULL mark) when
+            # the probe key is NULL or the build side contains a NULL key;
+            # IN over the empty set is FALSE even for NULL probes
+            null_aware, has_null_build, build_nonempty = semi
+            mark_valid = None
+            if null_aware and build_nonempty:
+                if has_null_build:
+                    unknown = ~matched
+                else:
+                    null_probe = jnp.zeros((n_probe,), jnp.bool_)
+                    for v in pkvalids:
+                        if v is not None:
+                            null_probe = null_probe | ~v
+                    unknown = ~matched & null_probe
+                mark_valid = ~unknown
+            return None, ok, matched, max_per_probe, (matched, mark_valid)
+        return pairs, ok, matched, max_per_probe, build_id
+
+    return jax.jit(fn)
+
+
+def run_pairs(table: DeviceJoinTable, lo, counts, total: int,
+              probe_keys, remaps, probe_cols, build_cols,
+              pair_types, pair_dicts,
+              residual: Optional[RowExpression],
+              need_matched: bool, semi: Optional[tuple] = None):
+    """Execute the pair program.  Returns (pair_cols|None, pair_live,
+    matched|None, max_per_probe|None, mark|None) — ALL device arrays, zero
+    host syncs.  ``pair_cols`` is [(data, valid|None), ...] over probe cols
+    then build cols, gathered at the matched pairs.  The 5th element is the
+    device build_id per pair slot for a regular join, or the (data, valid)
+    semi-join mark when ``semi`` is set."""
+    cap = K.bucket(max(total, 1))
+    has_pvalid = tuple(v is not None for _, v in probe_keys)
+    has_remap = tuple(r is not None for r in remaps)
+    pcol_has_valid = tuple(v is not None for _, v in probe_cols)
+    bcol_has_valid = tuple(v is not None for _, v in build_cols)
+    key = (cap, len(probe_keys), has_pvalid, has_remap,
+           tuple(str(t) for t in pair_types),
+           tuple(id(d) if d is not None else None for d in pair_dicts),
+           len(probe_cols), len(build_cols), pcol_has_valid, bcol_has_valid,
+           residual, need_matched, semi)
+    with _PAIR_LOCK:
+        hit = _PAIR_CACHE.get(key)
+    if hit is None:
+        prog = _make_pair_fn(cap, len(probe_keys), has_pvalid, has_remap,
+                             list(pair_types), list(pair_dicts),
+                             len(probe_cols), len(build_cols),
+                             pcol_has_valid, bcol_has_valid,
+                             residual, need_matched, semi)
+        with _PAIR_LOCK:
+            # the value holds pair_dicts: the id()-keyed component must not
+            # be recycled by the allocator while the entry lives
+            _PAIR_CACHE.setdefault(key, (prog, list(pair_dicts)))
+            if len(_PAIR_CACHE) > 1024:
+                _PAIR_CACHE.pop(next(iter(_PAIR_CACHE)))
+            prog = _PAIR_CACHE[key][0]
+    else:
+        prog = hit[0]
+
+    flat: list = []
+    for (d, v), r in zip(probe_keys, remaps):
+        flat.append(jnp.asarray(d))
+        if r is not None:
+            flat.append(jnp.asarray(r))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    for d, v in probe_cols:
+        flat.append(jnp.asarray(d))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    for d, v in build_cols:
+        flat.append(jnp.asarray(d))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    flat.extend(table.key_datas)
+    pairs, ok, matched, maxc, extra = prog(
+        lo, counts, jnp.asarray(total, jnp.int64), table.perm, *flat)
+    return pairs, ok, matched, maxc, extra

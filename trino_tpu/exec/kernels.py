@@ -51,6 +51,18 @@ def bucket(n: int, minimum: int = 8) -> int:
     return c
 
 
+def searchsorted(a, v, side: str = "left"):
+    """TPU-aware searchsorted: the default 'scan' method is a serial
+    binary search — log(n) dependent HBM gathers PER NEEDLE — measured at
+    ~1s for 2M needles on v5e, while the 'sort' method (sort the concat,
+    derive positions) rides the optimized XLA bitonic sort at ~1ms.  Small
+    needle counts keep 'scan' (sorting the haystack for 8 needles wastes a
+    full pass)."""
+    n_needles = int(np.prod(v.shape)) if hasattr(v, "shape") else 1
+    method = "sort" if n_needles >= 4096 else "scan"
+    return jnp.searchsorted(a, v, side=side, method=method)
+
+
 def _canon_float(x):
     """Canonicalize float keys so hashing/grouping agree with SQL equality:
     -0.0 -> +0.0 (they compare equal but have different bits) and every NaN
@@ -189,11 +201,11 @@ def _reduce_fn(spec: tuple, cap: int):
         outs = []
         n = perm.shape[0]
         ones = jnp.ones(perm.shape, dtype=jnp.int64)
-        starts = jnp.searchsorted(gid, jnp.arange(cap))
+        starts = searchsorted(gid, jnp.arange(cap))
         # end of group g = first row with gid > g (side='right'): when
         # num_groups == cap, ends[cap-1] must STOP at the dead-row region
         # (dead rows carry gid >= cap and form their own trailing segments)
-        ends = jnp.searchsorted(gid, jnp.arange(cap), side="right")
+        ends = searchsorted(gid, jnp.arange(cap), side="right")
         nonempty = ends > starts
         seg_first = jnp.concatenate(
             [jnp.ones((1,), jnp.bool_), gid[1:] != gid[:-1]])
@@ -605,7 +617,7 @@ def _keys_out_fn(has_valid: tuple, cap: int):
         # gid is sorted: group g's representative is its FIRST sorted row —
         # a binary-search gather, not a scatter (scatters serialize on TPU)
         n = perm.shape[0]
-        starts = jnp.minimum(jnp.searchsorted(gid, jnp.arange(cap)), n - 1)
+        starts = jnp.minimum(searchsorted(gid, jnp.arange(cap)), n - 1)
         rows = perm[starts]
         out = []
         i = 0
@@ -686,6 +698,72 @@ def _sort_columns(keys: Sequence[tuple], xp):
             null_rank = xp.where(v, 1, 0) if nulls_first else xp.where(v, 0, 1)
             sort_cols.append(null_rank)
     return sort_cols
+
+
+@lru_cache(maxsize=None)
+def _device_sort_fn(num_keys: int, key_meta: tuple, col_has_valid: tuple,
+                    has_live: bool, out_n: Optional[int]):
+    """One jitted program: lexsort + gather every payload column (+ live).
+    ``key_meta``: (has_valid, ascending, nulls_first) per key, major->minor.
+    Dead rows sort last regardless of key values (the live rank is the most
+    significant sort column), so a ``live``-masked batch stays valid after
+    sorting and ``out_n`` (top-N) keeps the best live rows."""
+
+    @jax.jit
+    def fn(*flat):
+        i = 0
+        keys = []
+        for hv, asc, nf in key_meta:
+            d = flat[i]
+            i += 1
+            v = None
+            if hv:
+                v = flat[i]
+                i += 1
+            keys.append((d, v, asc, nf))
+        cols = []
+        for hv in col_has_valid:
+            d = flat[i]
+            i += 1
+            v = None
+            if hv:
+                v = flat[i]
+                i += 1
+            cols.append((d, v))
+        live = flat[i] if has_live else None
+        sort_cols = _sort_columns(keys, jnp)
+        if live is not None:
+            sort_cols.append(~live)  # most significant: dead rows last
+        perm = jnp.lexsort(tuple(sort_cols))
+        if out_n is not None:
+            perm = perm[:out_n]
+        outs = [(d[perm], None if v is None else v[perm]) for d, v in cols]
+        return outs, (None if live is None else live[perm])
+
+    return fn
+
+
+def device_sort(keys: Sequence[tuple], cols: Sequence[tuple], live,
+                out_n: Optional[int] = None):
+    """keys: [(data, valid|None, ascending, nulls_first), ...] major->minor;
+    cols: [(data, valid|None), ...] payload.  Returns (sorted cols, sorted
+    live) — all device, zero host syncs."""
+    key_meta = tuple((v is not None, bool(a), bool(nf))
+                     for _, v, a, nf in keys)
+    col_has_valid = tuple(v is not None for _, v in cols)
+    flat: list = []
+    for d, v, _, _ in keys:
+        flat.append(jnp.asarray(d))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    for d, v in cols:
+        flat.append(jnp.asarray(d))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    if live is not None:
+        flat.append(jnp.asarray(live))
+    return _device_sort_fn(len(keys), key_meta, col_has_valid,
+                           live is not None, out_n)(*flat)
 
 
 def sort_perm(keys: Sequence[tuple]) -> np.ndarray:
@@ -819,8 +897,8 @@ def build_join_table(keys: Sequence[tuple], num_rows: Optional[int] = None) -> J
 def _probe_ranges_fn():
     @jax.jit
     def fn(sorted_hash, probe_hash):
-        lo = jnp.searchsorted(sorted_hash, probe_hash, side="left")
-        hi = jnp.searchsorted(sorted_hash, probe_hash, side="right")
+        lo = searchsorted(sorted_hash, probe_hash, side="left")
+        hi = searchsorted(sorted_hash, probe_hash, side="right")
         return lo, hi - lo
 
     return fn
@@ -838,7 +916,7 @@ def _expand_fn(cap: int):
         ends = jnp.cumsum(counts)
         starts = ends - counts
         slot = jnp.arange(cap)
-        probe_id = jnp.clip(jnp.searchsorted(ends, slot, side="right"), 0, n - 1)
+        probe_id = jnp.clip(searchsorted(ends, slot, side="right"), 0, n - 1)
         within = slot - starts[probe_id]
         build_pos = lo[probe_id] + within
         return probe_id, perm[jnp.clip(build_pos, 0, perm.shape[0] - 1)]
@@ -899,6 +977,62 @@ def probe_join_table(
 
 # ---------------------------------------------------------------------------
 # partitioning (shuffle producer — PagePartitioner.partitionPage equivalent)
+
+
+@lru_cache(maxsize=None)
+def _domain_fn(has_valid: bool, has_live: bool, dict_len: int):
+    """Build-key domain for dynamic filtering, all on device: returns
+    (valid_count, non-NaN count, min, max, presence-per-dictionary-code).
+    Presence uses sort + binary search, not scatter (scatters serialize)."""
+
+    @jax.jit
+    def fn(data, *rest):
+        i = 0
+        valid = rest[i] if has_valid else None
+        i += 1 if has_valid else 0
+        live = rest[i] if has_live else None
+        eligible = None
+        if valid is not None:
+            eligible = valid
+        if live is not None:
+            eligible = live if eligible is None else (eligible & live)
+        kind = np.dtype(data.dtype).kind
+        if eligible is None:
+            cnt = jnp.asarray(data.shape[0], jnp.int64)
+        else:
+            cnt = jnp.sum(eligible)
+        if kind == "f":
+            nan = jnp.isnan(data)
+            ok = ~nan if eligible is None else (eligible & ~nan)
+            cnt_nonnan = jnp.sum(ok)
+        else:
+            ok = eligible
+            cnt_nonnan = cnt
+        big = _sentinel("min", data.dtype)
+        small = _sentinel("max", data.dtype)
+        vmin = jnp.min(data if ok is None else jnp.where(ok, data, big))
+        vmax = jnp.max(data if ok is None else jnp.where(ok, data, small))
+        if dict_len:
+            sent = jnp.asarray(dict_len, data.dtype)
+            codes = jnp.sort(data if eligible is None
+                             else jnp.where(eligible, data, sent))
+            r = jnp.arange(dict_len, dtype=data.dtype)
+            presence = (jnp.searchsorted(codes, r, side="right")
+                        > jnp.searchsorted(codes, r, side="left"))
+        else:
+            presence = jnp.zeros((0,), jnp.bool_)
+        return cnt, cnt_nonnan, vmin, vmax, presence
+
+    return fn
+
+
+def _device_domain(data, valid, live, dict_len: int):
+    flat = [jnp.asarray(data)]
+    if valid is not None:
+        flat.append(jnp.asarray(valid))
+    if live is not None:
+        flat.append(jnp.asarray(live))
+    return _domain_fn(valid is not None, live is not None, dict_len)(*flat)
 
 
 def partition_assignments(keys: Sequence[tuple], num_partitions: int) -> np.ndarray:

@@ -861,35 +861,40 @@ class JoinBridge:
     equivalent — operator/join/PartitionedLookupSourceFactory.java)."""
 
     def __init__(self):
-        self.table: Optional[K.JoinTable] = None
+        self.table = None  # join_exec.DeviceJoinTable
         self.batch: Optional[ColumnBatch] = None
         self.key_dicts: list[Optional[np.ndarray]] = []
+        self._dense: Optional[ColumnBatch] = None
 
     @property
     def ready(self) -> bool:
         return self.table is not None
 
+    def dense(self) -> ColumnBatch:
+        """Host-compacted build batch (cross-join / epilogue paths only)."""
+        if self._dense is None:
+            self._dense = self.batch.compact()
+        return self._dense
 
-def _probe_key_tuple(col: Column, build_dict: Optional[np.ndarray]):
-    """(data, valid) for a probe key, remapping dictionary codes into the
-    build side's code space when the two sides carry different dictionaries
-    (string equi-join correctness: code i means different strings per dict).
-    The remap table is computed host-side over the (small) dictionaries; the
-    code gather stays on device when the column is device-resident."""
-    data, valid = col.data, col.valid
+
+def _probe_key_remap(col: Column, build_dict: Optional[np.ndarray]):
+    """Host-side remap table translating probe dictionary codes into the
+    build side's code space (-1 = value absent, can never match), or None
+    when the code spaces already agree.  The table is tiny (dictionary-
+    sized); the per-row gather happens inside the probe program on device."""
     pdict = col.dictionary
-    if pdict is not None or build_dict is not None:
-        if build_dict is None or len(build_dict) == 0:
-            # build side has no dictionary: nothing can match by value
-            return np.full(len(col), -1, np.int64), valid
-        if pdict is not None and pdict is not build_dict:
-            pos = np.searchsorted(build_dict, pdict)
-            clipped = np.clip(pos, 0, len(build_dict) - 1)
-            ok = build_dict[clipped] == pdict
-            remap = np.where(ok, clipped, -1).astype(np.int64)
-            data = (remap[data] if isinstance(data, np.ndarray)
-                    else jnp.asarray(remap)[data])
-    return data, valid
+    if pdict is None and build_dict is None:
+        return None
+    if build_dict is None or len(build_dict) == 0:
+        return np.full(max(len(pdict), 1), -1, np.int32)
+    if pdict is None or pdict is build_dict:
+        return None
+    if pdict.shape == build_dict.shape and (pdict == build_dict).all():
+        return None
+    pos = np.searchsorted(build_dict, pdict)
+    clipped = np.clip(pos, 0, len(build_dict) - 1)
+    ok = build_dict[clipped] == pdict
+    return np.where(ok, clipped, -1).astype(np.int32)
 
 
 class JoinBuildSink(BufferedInputMixin, Operator):
@@ -914,26 +919,29 @@ class JoinBuildSink(BufferedInputMixin, Operator):
             self.account_memory()
 
     def finish_input(self) -> None:
+        from . import join_exec as JX
+
         super().finish_input()
         if self.buffered_batches():
-            batch = ColumnBatch.concat(self._batches)
+            batch = _concat_device(self._batches)
         else:
             batch = ColumnBatch(self.names, [
                 Column(t, np.empty(0, t.storage_dtype)) for t in self.types])
+        live = batch.live
         keys = []
         for ch in self.key_channels:
             c = batch.columns[ch]
-            keys.append((np.asarray(c.data),
-                         None if c.valid is None else np.asarray(c.valid)))
+            keys.append((c.data, c.valid))
         for k, holder in zip(range(len(self.key_channels)),
                              self.dynamic_filter_holders):
             if holder is not None:
                 c = batch.columns[self.key_channels[k]]
-                holder.fill(keys[k][0], keys[k][1], c.dictionary)
+                holder.fill_device(c.data, c.valid, live, c.dictionary)
         self.bridge.batch = batch
         self.bridge.key_dicts = [
             batch.columns[ch].dictionary for ch in self.key_channels]
-        self.bridge.table = K.build_join_table(keys, num_rows=batch.num_rows)
+        self.bridge.table = JX.build_table(
+            keys, live=live, num_rows=batch.num_rows)
         self.release_memory()
 
     def is_finished(self) -> bool:
@@ -987,13 +995,39 @@ def _pad_indices(idx: np.ndarray) -> tuple[np.ndarray, int]:
     return np.concatenate([idx, np.zeros(cap - n, idx.dtype)]), n
 
 
+def _nested_loop_pairs(probe: ColumnBatch, build: ColumnBatch,
+                       residual: Optional[RowExpression]):
+    """Host nested-loop pair expansion shared by the cross join and the
+    keyless semi-join (operator/join/NestedLoopJoinOperator.java:45): the
+    full (probe x build) product, filtered by the jitted residual program.
+    Returns post-residual (pi, bi) index arrays."""
+    nb = build.num_rows
+    pi = np.repeat(np.arange(probe.num_rows, dtype=np.int64), nb)
+    bi = np.tile(np.arange(nb, dtype=np.int64), probe.num_rows)
+    if residual is None or not len(pi):
+        return pi, bi
+    pidx, n = _pad_indices(pi)
+    bidx, _ = _pad_indices(bi)
+    cols = ([c.take(pidx) for c in probe.columns]
+            + [c.take(bidx) for c in build.columns])
+    pair = ColumnBatch([f"c{i}" for i in range(len(cols))], cols)
+    prog = _residual_program(
+        residual, [c.type for c in pair.columns],
+        [c.dictionary for c in pair.columns])
+    mask = np.asarray(jax.device_get(prog(_to_cols(pair))))[:n]
+    return pi[mask], bi[mask]
+
+
 class LookupJoinOperator(Operator):
     """Probe side of the equi-join (operator/join/LookupJoinOperator.java:37).
-    Streams probe batches against the finished build table.  RIGHT/FULL
-    track matched build positions across all probe batches and emit the
-    unmatched build rows null-extended after input finishes (the
-    OUTER lookup-source variants of the reference —
-    operator/join/LookupJoinOperator probe-outer/build-outer modes)."""
+    Streams probe batches against the finished build table.  The whole probe
+    runs on device (exec/join_exec.py): candidate ranges, expansion, exact
+    verification, residual, and output gathers are jitted programs; the only
+    blocking host interaction per batch is the one scalar candidate-count
+    sync that picks the expansion bucket.  RIGHT/FULL track matched build
+    positions across all probe batches and emit the unmatched build rows
+    null-extended after input finishes (the OUTER lookup-source variants of
+    the reference)."""
 
     def __init__(self, bridge: JoinBridge, left_keys: Sequence[int],
                  join_type: str, residual: Optional[RowExpression],
@@ -1007,7 +1041,7 @@ class LookupJoinOperator(Operator):
         from collections import deque
 
         self._pending: "deque[ColumnBatch]" = deque()
-        self._build_matched: Optional[np.ndarray] = None
+        self._build_matched = None  # device bool per build slot (RIGHT/FULL)
         self._emitted_unmatched = False
         # probe-side dictionaries observed, for null-extended unmatched rows
         self._probe_dicts: Optional[list] = None
@@ -1015,84 +1049,117 @@ class LookupJoinOperator(Operator):
     def needs_input(self) -> bool:
         return self.bridge.ready and not self._pending and super().needs_input()
 
-    def add_input(self, probe: ColumnBatch) -> None:
-        build = self.bridge.batch
-        if not self.left_keys:  # cross join (nested-loop fallback)
-            probe = probe.compact()
-            pi, bi = K.probe_join_table(self.bridge.table, probe.num_rows)
-        else:
-            keys = [
-                _probe_key_tuple(probe.columns[ch], self.bridge.key_dicts[k])
-                for k, ch in enumerate(self.left_keys)
-            ]
-            pi, bi = K.probe_join_table(self.bridge.table, keys, probe.live)
-        if self.join_type == "SINGLE" and len(pi):
-            # scalar subquery: any probe row with >1 match is a cardinality
-            # violation (Trino: EnforceSingleRowNode -> "Scalar sub-query
-            # has returned multiple rows")
-            if len(pi) > probe.num_rows or np.bincount(
-                    pi, minlength=probe.num_rows).max() > 1:
-                raise RuntimeError("scalar subquery returned multiple rows")
-
-        if self.residual is not None and len(pi):
-            # pad candidates to their bucket so the jitted residual program
-            # (and every downstream shape) recompiles per bucket, not per
-            # distinct match count
-            pidx, n = _pad_indices(pi)
-            bidx, _ = _pad_indices(bi)
-            pair = self._pair_batch(probe, build, pidx, bidx)
-            prog = _residual_program(
-                self.residual, [c.type for c in pair.columns],
-                [c.dictionary for c in pair.columns])
-            mask = np.asarray(jax.device_get(prog(_to_cols(pair))))[:n]
-            pi, bi = pi[mask], bi[mask]
-
+    def _add_cross_input(self, probe: ColumnBatch) -> None:
+        """Nested-loop fallback (operator/join/NestedLoopJoinOperator.java:45)
+        — host-side; inherently quadratic and only planned for tiny inputs."""
+        probe = probe.compact()
+        build = self.bridge.dense()
+        nb = build.num_rows
+        self._dense_build = build  # epilogue indexes match this batch
+        if self.join_type == "SINGLE" and nb > 1 and probe.num_rows:
+            raise RuntimeError("scalar subquery returned multiple rows")
+        pi, bi = _nested_loop_pairs(probe, build, self.residual)
         if self.join_type in ("RIGHT", "FULL"):
             if self._build_matched is None:
-                self._build_matched = np.zeros(build.num_rows, bool)
+                self._build_matched = np.zeros(nb, bool)
             if len(bi):
-                self._build_matched[np.asarray(bi)] = True
+                m = np.asarray(self._build_matched)
+                m[bi] = True
+                self._build_matched = m
             self._probe_dicts = [c.dictionary for c in probe.columns]
-
         if self.join_type in ("LEFT", "SINGLE", "FULL"):
             matched = np.zeros(probe.num_rows, bool)
             matched[pi] = True
-            alive = (np.ones(probe.num_rows, bool) if probe.live is None
-                     else np.asarray(probe.live))
-            un = np.nonzero(alive & ~matched)[0]
+            un = np.nonzero(~matched)[0]
             if len(un):
-                # null-extended unmatched probe rows go out as their own
-                # bucket-padded batch (no host-side concat with the pairs)
-                uidx, un_n = _pad_indices(un)
-                left_cols = [c.take(uidx) for c in probe.columns]
-                right_cols = _null_columns(build, len(uidx))
-                live = (None if len(uidx) == un_n
-                        else np.arange(len(uidx)) < un_n)
+                left_cols = [c.take(un) for c in probe.columns]
+                right_cols = _null_columns(build, len(un))
                 self._pending.append(ColumnBatch(
-                    self.output_names, left_cols + right_cols, live))
+                    self.output_names, left_cols + right_cols))
         if len(pi):
-            pidx, n = _pad_indices(pi)
-            bidx, _ = _pad_indices(bi)
-            out = self._pair_batch(probe, build, pidx, bidx)
-            live = None if len(pidx) == n else np.arange(len(pidx)) < n
-            self._pending.append(ColumnBatch(
-                self.output_names, out.columns, live))
+            cols = ([c.take(pi) for c in probe.columns]
+                    + [c.take(bi) for c in build.columns])
+            self._pending.append(ColumnBatch(self.output_names, cols))
 
-    def _pair_batch(self, probe: ColumnBatch, build: ColumnBatch,
-                    pi: np.ndarray, bi: np.ndarray) -> ColumnBatch:
-        cols = [c.take(pi) for c in probe.columns] + [c.take(bi) for c in build.columns]
-        names = list(probe.names) + list(build.names)
-        return ColumnBatch(names, cols)
+    def add_input(self, probe: ColumnBatch) -> None:
+        from . import join_exec as JX
+
+        if not self.left_keys:  # cross join (nested-loop fallback)
+            self._add_cross_input(probe)
+            return
+        build = self.bridge.batch
+        table = self.bridge.table
+        keys = [(probe.columns[ch].data, probe.columns[ch].valid)
+                for ch in self.left_keys]
+        remaps = [
+            _probe_key_remap(probe.columns[ch], self.bridge.key_dicts[k])
+            for k, ch in enumerate(self.left_keys)
+        ]
+        lo, counts, total = JX.probe_ranges(table, keys, remaps, probe.live)
+        need_matched = self.join_type in ("LEFT", "SINGLE", "FULL")
+        if self.join_type in ("RIGHT", "FULL"):
+            self._probe_dicts = [c.dictionary for c in probe.columns]
+
+        matched = None
+        if total:
+            probe_cols = [(c.data, c.valid) for c in probe.columns]
+            build_cols = [(c.data, c.valid) for c in build.columns]
+            pair_types = ([c.type for c in probe.columns]
+                          + [c.type for c in build.columns])
+            pair_dicts = ([c.dictionary for c in probe.columns]
+                          + [c.dictionary for c in build.columns])
+            pairs, ok, matched, maxc, build_id = JX.run_pairs(
+                table, lo, counts, total, keys, remaps, probe_cols,
+                build_cols, pair_types, pair_dicts, self.residual,
+                need_matched)
+            if self.join_type == "SINGLE":
+                # scalar subquery: >1 match per probe row is a cardinality
+                # violation (EnforceSingleRowNode semantics)
+                if int(maxc) > 1:
+                    raise RuntimeError("scalar subquery returned multiple rows")
+            if self.join_type in ("RIGHT", "FULL"):
+                if self._build_matched is None:
+                    self._build_matched = jnp.zeros(build.num_rows, jnp.bool_)
+                self._build_matched = jnp.asarray(
+                    self._build_matched).at[build_id].max(ok)
+            out_cols = [Column(t, d, v, dc) for (d, v), t, dc in
+                        zip(pairs, pair_types, pair_dicts)]
+            self._pending.append(
+                ColumnBatch(self.output_names, out_cols, ok))
+
+        if need_matched:
+            # unmatched probe rows ride the ORIGINAL probe batch shape with
+            # a live mask (no gather, no compaction): probe columns pass
+            # through, build columns are all-NULL
+            if matched is None:
+                un_live = probe.live  # nothing matched: all live rows
+            else:
+                un_live = ~matched if probe.live is None else (
+                    jnp.asarray(probe.live) & ~matched)
+            n = probe.num_rows
+            right_cols = [
+                Column(c.type, jnp.zeros(n, c.type.storage_dtype),
+                       jnp.zeros(n, jnp.bool_), c.dictionary)
+                for c in build.columns
+            ]
+            self._pending.append(ColumnBatch(
+                self.output_names, list(probe.columns) + right_cols, un_live))
+
+    _dense_build: Optional[ColumnBatch] = None  # set by the cross path
 
     def _unmatched_build_batch(self) -> Optional[ColumnBatch]:
         """RIGHT/FULL epilogue: build rows no probe row matched, with NULL
-        probe-side columns."""
-        build = self.bridge.batch
+        probe-side columns (runs once; host-side)."""
+        build = (self._dense_build if self._dense_build is not None
+                 else self.bridge.batch)
         if build is None or build.num_rows == 0:
             return None
-        matched = (self._build_matched if self._build_matched is not None
+        matched = (np.asarray(self._build_matched)
+                   if self._build_matched is not None
                    else np.zeros(build.num_rows, bool))
-        un = np.nonzero(~matched)[0]
+        alive = (np.ones(build.num_rows, bool) if build.live is None
+                 else np.asarray(build.live))
+        un = np.nonzero(alive & ~matched)[0]
         if not len(un):
             return None
         lw = len(self.output_types) - build.num_columns
@@ -1146,46 +1213,58 @@ class SemiJoinOperator(Operator):
     def needs_input(self) -> bool:
         return self.bridge.ready and self._pending is None and super().needs_input()
 
-    def add_input(self, batch: ColumnBatch) -> None:
-        if not self.source_keys:
-            # EXISTS with only non-equi residuals decorrelates to a keyless
-            # semi-join: every probe row pairs with every build row and the
-            # residual alone decides the mark (cross-join fallback, same as
-            # LookupJoinOperator).
-            batch = batch.compact()
-        keys = []
-        null_probe = np.zeros(batch.num_rows, bool)
-        for k, ch in enumerate(self.source_keys):
-            c = batch.columns[ch]
-            bdict = self.bridge.key_dicts[k] if k < len(self.bridge.key_dicts) else None
-            keys.append(_probe_key_tuple(c, bdict))
-            if c.valid is not None:
-                null_probe |= ~np.asarray(c.valid)
-        if not self.source_keys:
-            pi, bi = K.probe_join_table(self.bridge.table, batch.num_rows)
-        else:
-            pi, bi = K.probe_join_table(self.bridge.table, keys, batch.live)
-        if self.residual is not None and len(pi):
-            pidx, n = _pad_indices(pi)
-            bidx, _ = _pad_indices(bi)
-            pair_cols = [c.take(pidx) for c in batch.columns] + [
-                c.take(bidx) for c in self.bridge.batch.columns]
-            pair = ColumnBatch(
-                [f"c{i}" for i in range(len(pair_cols))], pair_cols)
-            prog = _residual_program(
-                self.residual, [c.type for c in pair.columns],
-                [c.dictionary for c in pair.columns])
-            mask = np.asarray(jax.device_get(prog(_to_cols(pair))))[:n]
-            pi = pi[mask]
+    def _add_keyless_input(self, batch: ColumnBatch) -> None:
+        """EXISTS with only non-equi residuals decorrelates to a keyless
+        semi-join: every probe row pairs with every build row and the
+        residual alone decides the mark (host nested-loop fallback)."""
+        batch = batch.compact()
+        build = self.bridge.dense()
+        pi, _ = _nested_loop_pairs(batch, build, self.residual)
         matched = np.zeros(batch.num_rows, bool)
         matched[pi] = True
-        valid = None
+        mark = Column(BOOLEAN, matched, None)
+        self._pending = ColumnBatch(
+            self.output_names, list(batch.columns) + [mark], batch.live)
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        from . import join_exec as JX
+
+        if not self.source_keys:
+            self._add_keyless_input(batch)
+            return
+        table = self.bridge.table
+        build = self.bridge.batch
+        if table.num_rows == 0:
+            # IN over the empty set is FALSE (never UNKNOWN)
+            mark = Column(BOOLEAN, np.zeros(batch.num_rows, bool), None)
+            self._pending = ColumnBatch(
+                self.output_names, list(batch.columns) + [mark], batch.live)
+            return
+        keys = []
+        remaps = []
+        for k, ch in enumerate(self.source_keys):
+            c = batch.columns[ch]
+            bdict = (self.bridge.key_dicts[k]
+                     if k < len(self.bridge.key_dicts) else None)
+            keys.append((c.data, c.valid))
+            remaps.append(_probe_key_remap(c, bdict))
+        lo, counts, total = JX.probe_ranges(table, keys, remaps, batch.live)
         # IN over the empty set is FALSE (never UNKNOWN) even for NULL probes
-        if self.null_aware and self.bridge.table.num_rows > 0:
-            unknown = ~matched & (null_probe | self.bridge.table.has_null_key)
-            if unknown.any():
-                valid = ~unknown
-        mark = Column(BOOLEAN, matched, valid)
+        semi = (self.null_aware, table.has_null_key, table.live_rows > 0)
+        if self.residual is not None:
+            probe_cols = [(c.data, c.valid) for c in batch.columns]
+            build_cols = [(c.data, c.valid) for c in build.columns]
+            pair_types = ([c.type for c in batch.columns]
+                          + [c.type for c in build.columns])
+            pair_dicts = ([c.dictionary for c in batch.columns]
+                          + [c.dictionary for c in build.columns])
+        else:
+            probe_cols, build_cols, pair_types, pair_dicts = [], [], [], []
+        _, _, _, _, mark_out = JX.run_pairs(
+            table, lo, counts, total, keys, remaps, probe_cols, build_cols,
+            pair_types, pair_dicts, self.residual, False, semi=semi)
+        mark_data, mark_valid = mark_out
+        mark = Column(BOOLEAN, mark_data, mark_valid)
         self._pending = ColumnBatch(
             self.output_names, list(batch.columns) + [mark], batch.live)
 
@@ -1288,7 +1367,25 @@ def _sort_key_tuples(batch: ColumnBatch, keys: Sequence[SortKey]):
     return out
 
 
+def _any_device(batches: Sequence[ColumnBatch]) -> bool:
+    for b in batches:
+        if b.live is not None and not isinstance(b.live, np.ndarray):
+            return True
+        for c in b.columns:
+            if not isinstance(c.data, np.ndarray):
+                return True
+    return False
+
+
 class SortOperator(BufferedInputMixin, Operator):
+    """Full sort (operator/OrderByOperator.java:44).  Device-resident input
+    sorts on chip as ONE jitted program (lexsort + payload gather, dead rows
+    last) with zero host syncs; small host-resident input keeps the numpy
+    path — shipping tiny post-aggregation sorts through a tunneled device
+    costs ~1000x the sort itself."""
+
+    limit: Optional[int] = None  # TopN sets this
+
     def __init__(self, keys: Sequence[SortKey]):
         self.keys = list(keys)
         self._batches: list[ColumnBatch] = []
@@ -1300,14 +1397,31 @@ class SortOperator(BufferedInputMixin, Operator):
             self._batches.append(batch)
             self.account_memory()
 
+    def _sorted_batch(self, batches: Sequence[ColumnBatch],
+                      out_n: Optional[int]) -> ColumnBatch:
+        if _any_device(batches):
+            inp = _concat_device(batches)
+            keys = [(inp.columns[k.channel].data, inp.columns[k.channel].valid,
+                     k.ascending, k.nulls_first) for k in self.keys]
+            cols = [(c.data, c.valid) for c in inp.columns]
+            n = inp.num_rows
+            cap = None if out_n is None else min(out_n, n)
+            outs, live = K.device_sort(keys, cols, inp.live, cap)
+            out_cols = [Column(c.type, d, v, c.dictionary)
+                        for (d, v), c in zip(outs, inp.columns)]
+            return ColumnBatch(inp.names, out_cols, live)
+        inp = ColumnBatch.concat(batches)
+        perm = K.sort_perm(_sort_key_tuples(inp, self.keys))
+        if out_n is not None:
+            perm = np.asarray(perm)[:out_n]
+        return inp.take(perm)
+
     def finish_input(self) -> None:
         super().finish_input()
         if not self.buffered_batches():
             self._emitted = True
             return
-        inp = ColumnBatch.concat(self._batches)
-        perm = K.sort_perm(_sort_key_tuples(inp, self.keys))
-        self._result = inp.take(perm)
+        self._result = self._sorted_batch(self._batches, self.limit)
         self.release_memory()
 
     def get_output(self):
@@ -1328,6 +1442,7 @@ class TopNOperator(SortOperator):
     def __init__(self, count: int, keys: Sequence[SortKey]):
         super().__init__(keys)
         self.count = count
+        self.limit = count
         self._buffered_rows = 0
         self._shrink_at = max(4 * count, 1 << 16)
 
@@ -1341,16 +1456,9 @@ class TopNOperator(SortOperator):
         self.account_memory()
 
     def _shrink(self) -> None:
-        inp = ColumnBatch.concat(self.buffered_batches())
-        perm = K.sort_perm(_sort_key_tuples(inp, self.keys))
-        best = inp.take(np.asarray(perm)[: self.count])
+        best = self._sorted_batch(self.buffered_batches(), self.count)
         self._batches = [best]
         self._buffered_rows = best.num_rows
-
-    def finish_input(self) -> None:
-        super().finish_input()
-        if self._result is not None:
-            self._result = self._result.slice(0, self.count)
 
 
 class GroupIdOperator(Operator):

@@ -16,7 +16,7 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}:
 A bytes/s sanity line goes to stderr: scanned-bytes/s must stay below HBM
 peak (~0.8 TB/s on v5e) or the measurement is rejected as bogus.
 
-Env knobs: BENCH_SF (default 0.2), BENCH_ITERS (default 3),
+Env knobs: BENCH_SF (default 2), BENCH_ITERS (default 3),
 BENCH_BASELINE_WORKERS (default 8), BENCH_SKIP_BASELINE=1 to skip.
 """
 
@@ -144,7 +144,7 @@ def _time_queries(runner, iters: int) -> dict[str, float]:
 def run_baseline() -> None:
     """CPU reference: same engine, same data, 8-worker DistributedQueryRunner.
     Runs in a subprocess with JAX_PLATFORMS=cpu (BASELINE.md config #1)."""
-    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    sf = float(os.environ.get("BENCH_SF", "2"))
     workers = int(os.environ.get("BENCH_BASELINE_WORKERS", "8"))
     _enable_compile_cache()
     from trino_tpu.execution.distributed_runner import DistributedQueryRunner
@@ -168,7 +168,7 @@ def main() -> None:
         run_baseline()
         return
 
-    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    sf = float(os.environ.get("BENCH_SF", "2"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     _enable_compile_cache()
 
@@ -203,7 +203,7 @@ def main() -> None:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--baseline"],
-            env=env, capture_output=True, text=True, timeout=3600)
+            env=env, capture_output=True, text=True, timeout=7200)
         if proc.returncode == 0:
             base = json.loads(proc.stdout.strip().splitlines()[-1])
             base_total = sum(base[q] for q in QUERIES)

@@ -113,3 +113,19 @@ def test_string_keys_route_by_value(harness):
     result = dist.execute(sql)
     assert dist._collective_edges
     assert_same_rows(result.rows(), oracle.query(sql))
+
+
+@pytest.mark.parametrize("q", [3, 5, 10])
+def test_tpch_via_tiled_raw_row_collectives(harness, monkeypatch, q):
+    """Raw-row repartition: force every collective edge through the tiled
+    sorted-bucket all_to_all (local sort by owner + per-destination tiles)
+    instead of the broadcast lane layout; join-heavy TPC-H queries must stay
+    oracle-correct with the rows riding the mesh (round-4 VERDICT item #2;
+    reference: operator/output/PagePartitioner.java:134)."""
+    dist, oracle = harness
+    monkeypatch.setattr(CE, "TILED_THRESHOLD_ROWS", 0)
+    sql = QUERIES[q]
+    result = dist.execute(sql)
+    assert dist._collective_edges, "no collective edges in plan"
+    assert_same_rows(result.rows(), oracle.query(sql),
+                     ordered="order by" in sql.lower())

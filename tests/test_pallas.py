@@ -66,8 +66,12 @@ def test_engine_real_sum_uses_pallas(monkeypatch):
             if b is not None:
                 batches.append(b)
     oracle.load_table("lineitem", batches)
-    sql = ("select l_returnflag, sum(cast(l_quantity as real)) "
-           "from lineitem group by l_returnflag")
+    # group on a NUMERIC key: dictionary-coded keys now take the masked
+    # small-group path (kernels.small_grouped_aggregate) and never reach
+    # the pallas f32 segment-sum; a non-dictionary key keeps the sort-based
+    # path where the pallas fast lane lives
+    sql = ("select l_linenumber, sum(cast(l_quantity as real)) "
+           "from lineitem group by l_linenumber")
     result = runner.execute(sql).rows()
     assert calls and any(calls), "REAL sum did not route through pallas"
     assert_same_rows(result, oracle.query(sql))

@@ -130,12 +130,34 @@ class LocalPlanner:
 
         if isinstance(node, P.Filter):
             chain = self._chain(node.source)
+            last = chain[-1] if chain else None
+            if (isinstance(last, FilterProjectOperator)
+                    and last.projections is None):
+                # Filter over Filter: AND the predicates into one program
+                from ..spi.types import BOOLEAN
+                from ..sql.ir import Call
+
+                pred = node.predicate if last.predicate is None else Call(
+                    BOOLEAN, "$and", (last.predicate, node.predicate))
+                chain[-1] = FilterProjectOperator(
+                    pred, None, node.output_names, node.output_types)
+                return chain
             chain.append(FilterProjectOperator(
                 node.predicate, None, node.output_names, node.output_types))
             return chain
 
         if isinstance(node, P.Project):
             chain = self._chain(node.source)
+            last = chain[-1] if chain else None
+            if (isinstance(last, FilterProjectOperator)
+                    and last.projections is None):
+                # Project over Filter: ONE fused filter+project program per
+                # batch instead of two (ScanFilterAndProject fusion —
+                # reference: operator/ScanFilterAndProjectOperator.java:68)
+                chain[-1] = FilterProjectOperator(
+                    last.predicate, node.expressions,
+                    node.output_names, node.output_types)
+                return chain
             chain.append(FilterProjectOperator(
                 None, node.expressions, node.output_names, node.output_types))
             return chain

@@ -689,22 +689,40 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         live = inp.live  # None = all rows real
         n = inp.num_rows
 
-        if nk:
-            key_cols = [inp.columns[i] for i in self.group_keys]
+        presence = None
+        # masked-reduction fast path: small dictionary-code group space and
+        # no DISTINCT -> no sort, no gather, no num_groups sync (kernels.
+        # small_grouped_aggregate); live folds via the fused gid, so specs
+        # skip the fold_live below
+        key_cols = [inp.columns[i] for i in self.group_keys]
+        space = K.small_codes_group_space(key_cols) if nk else 1
+        use_masked = (space is not None and space <= K.MASKED_AGG_LIMIT
+                      and not any(a.distinct for a in self.aggs)
+                      and (nk or live is not None
+                           or any(a.arg >= 0 for a in self.aggs)))
+        if nk and not use_masked:
             keys = [(c.data, c.valid) for c in key_cols]
-            perm, gid, num_groups = K.group_ids(keys, live)
-            if num_groups == 0:  # every row dead (fully filtered input)
-                return self._empty_result(nk)
-            keys_out = K.group_keys_out(perm, gid, num_groups, keys)
-        else:
+            if space is not None:
+                # all keys are small dictionary codes: static group space,
+                # single-key sort, zero host syncs; empty groups ride out
+                # as dead rows in the output's live mask
+                perm, gid, num_groups, presence, keys_out = (
+                    K.group_ids_codes(key_cols, live))
+            else:
+                perm, gid, num_groups = K.group_ids(keys, live)
+                if num_groups == 0:  # every row dead (fully filtered input)
+                    return self._empty_result(nk)
+                keys_out = K.group_keys_out(perm, gid, num_groups, keys)
+        elif not nk and not use_masked:
             key_cols, keys_out = [], []
             perm = jnp.arange(n)
             gid = jnp.zeros(n, jnp.int32)
             num_groups = 1
 
         def fold_live(valid):
-            """Dead rows never contribute: fold ``live`` into validity."""
-            if live is None:
+            """Dead rows never contribute: fold ``live`` into validity.
+            The masked path folds live via the fused group id instead."""
+            if use_masked or live is None:
                 return valid
             if valid is None:
                 return live
@@ -756,7 +774,12 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                 specs.append(("count", s[1], s[2], np.int64, False))
             else:
                 specs.append(s)
-        reduced = K.grouped_reduce(perm, gid, num_groups, specs) if specs else []
+        if use_masked:
+            reduced, presence, keys_out, num_groups = (
+                K.small_grouped_aggregate(key_cols, live, specs))
+        else:
+            reduced = (K.grouped_reduce(perm, gid, num_groups, specs)
+                       if specs else [])
 
         # finalization (avg division, variance combine, output casts) runs
         # as ONE compiled program over the tiny per-group arrays: zero eager
@@ -837,7 +860,7 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         outs = K.finalize_groups(plan, arrays)
         out_cols = [Column(t, d, v, dc)
                     for (d, v), t, dc in zip(outs, col_types, col_dicts)]
-        return ColumnBatch(self.output_names, out_cols)
+        return ColumnBatch(self.output_names, out_cols, presence)
 
     def get_output(self) -> Optional[ColumnBatch]:
         if self._flushed:

@@ -231,8 +231,10 @@ class DistributedQueryRunner:
                     threads.append(th)
             for th in threads:
                 th.start()
+            from .task import STALL_TIMEOUT_S
+
             for th in threads:
-                th.join(timeout=600)
+                th.join(timeout=2 * STALL_TIMEOUT_S)
             hung = [th.name for th in threads if th.is_alive()]
         if errors or hung:
             for s in stages.values():
@@ -362,7 +364,9 @@ class DistributedQueryRunner:
                     handles.append((f, t, executor.submit(pipelines, stats)))
             # poll every handle so the FIRST failure aborts all buffers
             # immediately (matching THREADS-mode fail-fast)
-            deadline = _time.monotonic() + 600
+            from .task import STALL_TIMEOUT_S
+
+            deadline = _time.monotonic() + 2 * STALL_TIMEOUT_S
             pending = list(range(len(handles)))
             while pending and _time.monotonic() < deadline:
                 still = []

@@ -8,6 +8,7 @@ operator/output/PartitionedOutputOperator.java:47 + TaskOutputOperator
 
 from __future__ import annotations
 
+import os
 import time
 import zlib
 from typing import Optional, Sequence
@@ -22,6 +23,12 @@ from .serde import deserialize_batch, serialize_batch
 
 __all__ = ["RemoteExchangeSourceOperator", "PartitionedOutputSink",
            "SerializedPage", "maybe_deserialize"]
+
+# How long an exchange consumer waits with NO upstream page before declaring
+# a stall.  First-run XLA compiles at large shapes can exceed several
+# minutes on CPU (the self-measured bench baseline), so the default is
+# generous; tests that probe deadlocks can lower it via the env knob.
+STALL_TIMEOUT_S = float(os.environ.get("TRINO_TPU_EXCHANGE_STALL_S", "1800"))
 
 
 class SerializedPage:
@@ -84,13 +91,14 @@ class RemoteExchangeSourceOperator(Operator):
             return maybe_deserialize(page) if page is not None else None
         # block until a page or all upstream producers finish; the driver
         # treats a None from a non-finished source as "try again"
-        deadline = time.monotonic() + 300.0
+        deadline = time.monotonic() + STALL_TIMEOUT_S
         while not self.client.is_finished():
             page = self.client.poll(timeout=0.2)
             if page is not None:
                 return maybe_deserialize(page)
             if time.monotonic() > deadline:
-                raise TimeoutError("exchange source stalled >300s")
+                raise TimeoutError(
+                    f"exchange source stalled >{STALL_TIMEOUT_S:.0f}s")
         return None
 
     def is_finished(self) -> bool:
@@ -124,7 +132,7 @@ class MergeSourceOperator(Operator):
 
     def _poll_all(self, wait: bool) -> bool:
         """Accumulate available pages; True when every stream is complete."""
-        deadline = time.monotonic() + 300.0
+        deadline = time.monotonic() + STALL_TIMEOUT_S
         while True:
             all_done = True
             progressed = False
@@ -140,9 +148,10 @@ class MergeSourceOperator(Operator):
             if all_done or not wait:
                 return all_done
             if progressed:
-                deadline = time.monotonic() + 300.0  # reset on activity
+                deadline = time.monotonic() + STALL_TIMEOUT_S  # reset on activity
             elif time.monotonic() > deadline:
-                raise TimeoutError("merge source stalled >300s")
+                raise TimeoutError(
+                    f"merge source stalled >{STALL_TIMEOUT_S:.0f}s")
 
     def _row_key(self, row):
         key = []

@@ -258,6 +258,72 @@ class DistributedQueryRunner:
                 batches.append(maybe_deserialize(b))
         return self._to_result(subplan, batches)
 
+    def fte_run_attempt(self, fragment, task_index: int, task_count: int,
+                        nparts: int, upstream: dict, spool_root: str,
+                        attempt: int, stats_sink: Optional[list]) -> str:
+        """Run ONE task attempt against the durable spool; returns the
+        committed attempt directory.  In-process execution here; the
+        process runner overrides this with a worker-process dispatch."""
+        import os as _os
+
+        from .durable_spool import DurableSpoolClient, DurableSpoolWriter
+        from .failure_injector import GET_RESULTS_FAILURE, TASK_FAILURE
+        from .fte import fte_task_dir
+        from .task import PartitionedOutputSink as _Sink
+
+        injector = getattr(self.session, "failure_injector", None)
+        if injector is not None:
+            injector.maybe_fail(TASK_FAILURE, fragment.id, task_index,
+                                attempt)
+
+        def on_read(_d, _fid=fragment.id, _t=task_index, _a=attempt):
+            if injector is not None:
+                injector.maybe_fail(GET_RESULTS_FAILURE, _fid, _t, _a)
+
+        clients = {}
+        for src, info in upstream.items():
+            if info["merge"]:
+                clients[src] = [
+                    DurableSpoolClient([d], task_index, on_read)
+                    for d in info["dirs"]
+                ]
+            else:
+                clients[src] = DurableSpoolClient(
+                    info["dirs"], task_index, on_read)
+        planner = LocalPlanner(
+            self.catalog,
+            splits_per_node=self.session.splits_per_node,
+            node_count=self.worker_count,
+            task_index=task_index,
+            task_count=task_count,
+            remote_clients=clients,
+            dynamic_filtering=self.session.dynamic_filtering,
+            hbm_limit_bytes=self.session.hbm_limit_bytes,
+        )
+        local = planner.plan(fragment.root)
+        task_dir = fte_task_dir(spool_root, fragment.id, task_index)
+        _os.makedirs(task_dir, exist_ok=True)
+        writer = DurableSpoolWriter(task_dir, attempt, nparts)
+        sink = _Sink(
+            writer,
+            fragment.output_kind if fragment.output_kind != "OUTPUT"
+            else "GATHER",
+            fragment.output_keys, serde=True)
+        local.pipelines[-1][-1] = sink
+        stats = None
+        if stats_sink is not None:
+            stats = QueryStats(
+                label=f"fragment {fragment.id} task {task_index}:")
+        try:
+            run_pipelines(local.pipelines, stats)
+        except BaseException:
+            writer.abort()
+            raise
+        writer.set_finished()
+        if stats is not None:
+            stats_sink.append(stats)
+        return writer.committed
+
     @property
     def active_worker_count(self) -> int:
         """Live, non-draining workers per discovery + failure detection;

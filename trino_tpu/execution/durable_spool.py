@@ -1,0 +1,124 @@
+"""Durable on-disk FTE spool: stage outputs survive task AND process death.
+
+The round-3 FTE spool was Python lists in RAM — "retry" only worked because
+failed tasks were threads that could not actually lose state (VERDICT item
+#4).  This module is the engine's FileSystemExchangeManager miniature
+(reference: plugin/trino-exchange-filesystem/.../FileSystemExchangeManager.
+java:40, FileSystemExchangeSink):
+
+- a task attempt writes its output as per-partition serde page files under
+  ``<spool_root>/f<fragment>_t<task>/attempt-<n>.tmp/part-<p>.bin``;
+- ``commit()`` atomically renames ``attempt-<n>.tmp`` -> ``attempt-<n>`` —
+  only committed attempts are ever read, so a torn write from a dying
+  process is invisible (the reference's exactly-once sink contract);
+- readers stream frames from the committed directory; a worker-process
+  death after commit loses nothing because the pages live on shared disk.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+import tempfile
+from typing import Iterator, Optional
+
+from ..spi.batch import ColumnBatch
+from .serde import deserialize_batch, iter_frames, serialize_batch
+
+__all__ = ["DurableSpoolWriter", "DurableSpoolClient", "make_spool_root"]
+
+
+def make_spool_root(base: Optional[str] = None) -> str:
+    return tempfile.mkdtemp(prefix="trino-tpu-spool-", dir=base)
+
+
+class DurableSpoolWriter:
+    """Duck-types the OutputBuffer surface PartitionedOutputSink uses
+    (enqueue / set_finished) but lands every page on disk."""
+
+    def __init__(self, task_dir: str, attempt: int, num_partitions: int):
+        self.num_partitions = num_partitions
+        self._final = os.path.join(task_dir, f"attempt-{attempt}")
+        self._tmp = self._final + ".tmp"
+        if os.path.exists(self._tmp):  # leftovers from a crashed twin
+            shutil.rmtree(self._tmp)
+        os.makedirs(self._tmp)
+        self._files = [
+            open(os.path.join(self._tmp, f"part-{p}.bin"), "wb")
+            for p in range(num_partitions)
+        ]
+        self.committed: Optional[str] = None
+
+    def enqueue(self, partition: int, page) -> None:
+        raw = page.data if hasattr(page, "data") else serialize_batch(page)
+        f = self._files[partition]
+        f.write(struct.pack("<I", len(raw)))
+        f.write(raw)
+
+    def set_finished(self) -> None:
+        if self.committed is not None:  # idempotent (sink + runner both call)
+            return
+        for f in self._files:
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+        # atomic commit: a crash before this rename leaves only a .tmp that
+        # no reader will ever open
+        if os.path.exists(self._final):
+            shutil.rmtree(self._tmp)
+        else:
+            os.rename(self._tmp, self._final)
+        self.committed = self._final
+
+    def abort(self) -> None:
+        for f in self._files:
+            try:
+                f.close()
+            except Exception:
+                pass
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+def _iter_partition(attempt_dir: str, partition: int) -> Iterator[ColumnBatch]:
+    path = os.path.join(attempt_dir, f"part-{partition}.bin")
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        for frame in iter_frames(f):
+            yield deserialize_batch(frame)
+
+
+class DurableSpoolClient:
+    """Duck-types ExchangeClient (poll / is_finished) over the committed
+    spools of every producer task of one fragment."""
+
+    def __init__(self, attempt_dirs: list[str], partition: int,
+                 on_read=None):
+        self._dirs = list(attempt_dirs)
+        self.partition = partition
+        self._iter = None
+        self._pushback = None  # one-slot peek buffer (is_finished look-ahead)
+        self._on_read = on_read  # failure-injection hook
+
+    def _pages(self):
+        for d in self._dirs:
+            if self._on_read is not None:
+                self._on_read(d)
+            yield from _iter_partition(d, self.partition)
+
+    def poll(self, timeout: float = 0.0):
+        if self._pushback is not None:
+            page, self._pushback = self._pushback, None
+            return page
+        if self._iter is None:
+            self._iter = self._pages()
+        return next(self._iter, None)
+
+    def is_finished(self) -> bool:
+        if self._pushback is not None:
+            return False
+        if self._iter is None:
+            self._iter = self._pages()
+        self._pushback = next(self._iter, None)
+        return self._pushback is None

@@ -1,0 +1,111 @@
+"""Engine-level failure injection (reference: execution/FailureInjector.java:35).
+
+The round-3 fault injection lived in a test-local connector wrapper; this is
+the engine hook: rules target (fragment_id, task_index, attempt) at named
+injection points and fire a bounded number of times.  Kinds mirror the
+reference's enum (FailureInjector.java:51):
+
+- ``TASK_FAILURE``               raise inside the task body
+- ``GET_RESULTS_FAILURE``        raise while reading an upstream spool/page
+- ``PROCESS_EXIT``               hard-kill the hosting process (worker mode
+                                 only — the real "node died" case)
+
+Rules travel inside task descriptors to worker processes, so process-mode
+FTE can deterministically lose a worker mid-stage.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["FailureInjector", "InjectedFailure",
+           "TASK_FAILURE", "GET_RESULTS_FAILURE", "PROCESS_EXIT"]
+
+TASK_FAILURE = "TASK_FAILURE"
+GET_RESULTS_FAILURE = "GET_RESULTS_FAILURE"
+PROCESS_EXIT = "PROCESS_EXIT"
+
+
+class InjectedFailure(RuntimeError):
+    pass
+
+
+@dataclass
+class _Rule:
+    kind: str
+    fragment_id: Optional[int] = None  # None = any
+    task_index: Optional[int] = None
+    attempt: Optional[int] = None
+    times: int = 1
+    fired: int = 0
+
+    def matches(self, kind: str, fragment_id: int, task_index: int,
+                attempt: int) -> bool:
+        return (self.fired < self.times and self.kind == kind
+                and (self.fragment_id is None
+                     or self.fragment_id == fragment_id)
+                and (self.task_index is None
+                     or self.task_index == task_index)
+                and (self.attempt is None or self.attempt == attempt))
+
+
+@dataclass
+class FailureInjector:
+    rules: list = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inject(self, kind: str, fragment_id: Optional[int] = None,
+               task_index: Optional[int] = None,
+               attempt: Optional[int] = None, times: int = 1) -> None:
+        self.rules.append(_Rule(kind, fragment_id, task_index, attempt,
+                                times))
+
+    def consume_for(self, fragment_id: int, task_index: int,
+                    attempt: int) -> list[dict]:
+        """Wire form for ONE task-attempt descriptor.  A rule whose scope
+        matches this attempt is counted as fired at export time (the worker
+        cannot report back — it may be dead), so ``times`` bounds hold
+        identically in-process and across processes."""
+        out = []
+        with self._lock:
+            for r in self.rules:
+                if r.fired >= r.times:
+                    continue
+                if ((r.fragment_id is None or r.fragment_id == fragment_id)
+                        and (r.task_index is None
+                             or r.task_index == task_index)
+                        and (r.attempt is None or r.attempt == attempt)):
+                    r.fired += 1
+                    out.append({"kind": r.kind, "fragment_id": fragment_id,
+                                "task_index": task_index,
+                                "attempt": attempt})
+        return out
+
+    def maybe_fail(self, kind: str, fragment_id: int, task_index: int,
+                   attempt: int = 0) -> None:
+        with self._lock:
+            for r in self.rules:
+                if r.matches(kind, fragment_id, task_index, attempt):
+                    r.fired += 1
+                    raise InjectedFailure(
+                        f"injected {kind} at f{fragment_id}.t{task_index} "
+                        f"attempt {attempt}")
+
+
+def check_wire_rules(rules: list[dict], kind: str, fragment_id: int,
+                     task_index: int, attempt: int) -> Optional[str]:
+    """Worker-side rule match over descriptor-carried rules.  Returns the
+    matched kind (the caller decides how to die) or None.  Attempt-scoped
+    rules make one-shot semantics deterministic without shared state: the
+    retry carries attempt+1 which no longer matches."""
+    for r in rules:
+        if (r["kind"] == kind
+                and (r["fragment_id"] is None
+                     or r["fragment_id"] == fragment_id)
+                and (r["task_index"] is None
+                     or r["task_index"] == task_index)
+                and (r["attempt"] is None or r["attempt"] == attempt)):
+            return r["kind"]
+    return None

@@ -1,17 +1,23 @@
-"""Fault-tolerant execution: stage-by-stage spooled exchange + task retry.
+"""Fault-tolerant execution: DURABLE spooled exchange + task retry.
 
 The miniature of the reference's FTE mode (execution/scheduler/
 faulttolerant/EventDrivenFaultTolerantQueryScheduler.java:201 +
 spi/exchange/ExchangeManager.java:39 spooling):
 
 - fragments run in topological order (producers complete before consumers
-  start), every task's output fully *spooled* per consumer partition;
+  start); every task's output is spooled TO DISK per consumer partition
+  with atomic attempt commit (execution/durable_spool.py — the
+  FileSystemExchangeManager role), so the unit of recovery genuinely
+  survives task AND worker-process death;
 - a failed task attempt is retried up to ``task_retry_attempts`` times with
-  a fresh output spool (tasks are deterministic in (fragment, task_index,
-  spooled inputs), so re-execution is exact);
-- consumers read the winning attempt's spool — a mid-stream producer death
-  can never poison a downstream task, which is exactly the property the
-  streaming pipelined scheduler gives up.
+  a fresh attempt directory (tasks are deterministic in (fragment,
+  task_index, committed inputs), so re-execution is exact);
+- consumers read only committed attempts — a mid-stream producer death can
+  never poison a downstream task, which is exactly the property the
+  streaming pipelined scheduler gives up;
+- engine-level failure injection (execution/failure_injector.py, the
+  FailureInjector.java:35 hook) targets task bodies, spool reads, or the
+  hosting worker process itself.
 
 The trade (identical to Trino FTE): no cross-stage streaming overlap, in
 exchange for retryability.  ``Session(retry_policy="TASK")`` selects it.
@@ -19,55 +25,17 @@ exchange for retryability.  ``Session(retry_policy="TASK")`` selects it.
 
 from __future__ import annotations
 
+import os
+import shutil
 import threading
 import time
-from typing import Optional, Sequence
+from typing import Optional
 
-from ..exec.driver import run_pipelines
-from ..exec.local_planner import LocalPlanner
-from ..exec.stats import QueryStats
+from .durable_spool import make_spool_root
 from .fragmenter import SubPlan
-from .task import PartitionedOutputSink, maybe_deserialize
+from .task import maybe_deserialize
 
-__all__ = ["SpoolBuffer", "SpooledExchangeClient", "run_fte_query"]
-
-
-class SpoolBuffer:
-    """Collects a task's full output per consumer partition (duck-types the
-    OutputBuffer surface PartitionedOutputSink uses)."""
-
-    def __init__(self, num_partitions: int):
-        self.num_partitions = num_partitions
-        self.pages: list[list] = [[] for _ in range(num_partitions)]
-        self.finished = False
-
-    def enqueue(self, partition: int, page) -> None:
-        self.pages[partition].append(page)
-
-    def set_finished(self) -> None:
-        self.finished = True
-
-
-class SpooledExchangeClient:
-    """Reads one consumer partition from every producer task's finished
-    spool (duck-types ExchangeClient for RemoteExchangeSourceOperator)."""
-
-    def __init__(self, spools: Sequence[SpoolBuffer], partition: int):
-        pages = []
-        for s in spools:
-            pages.extend(s.pages[partition])
-        self._pages = pages
-        self._i = 0
-
-    def poll(self, timeout: float = 0.0):
-        if self._i < len(self._pages):
-            page = self._pages[self._i]
-            self._i += 1
-            return page
-        return None
-
-    def is_finished(self) -> bool:
-        return self._i >= len(self._pages)
+__all__ = ["run_fte_query", "TaskFailure"]
 
 
 class TaskFailure(RuntimeError):
@@ -79,89 +47,73 @@ class TaskFailure(RuntimeError):
         self.cause = cause
 
 
+def fte_task_dir(spool_root: str, fragment_id: int, task_index: int) -> str:
+    return os.path.join(spool_root, f"f{fragment_id}_t{task_index}")
+
+
 def run_fte_query(runner, subplan: SubPlan,
                   stats_sink: Optional[list] = None) -> list:
-    """Execute the subplan stage-by-stage with task retry; returns the root
-    fragment's output batches."""
+    """Execute the subplan stage-by-stage with task retry over a durable
+    spool; returns the root fragment's output batches."""
     session = runner.session
     attempts_allowed = 1 + getattr(session, "task_retry_attempts", 2)
     fragments = subplan.all_fragments()  # children first = topological
 
     task_counts, consumer_tasks = runner.stage_task_counts(fragments)
     output_kinds = {f.id: f.output_kind for f in fragments}
+    spool_root = make_spool_root(getattr(session, "fte_spool_dir", None))
 
-    spools: dict[int, list[SpoolBuffer]] = {}
-    for f in fragments:
-        tc = task_counts[f.id]
-        nparts = consumer_tasks.get(f.id, 1)
+    # fragment id -> list of committed attempt dirs (one per task)
+    committed: dict[int, list[str]] = {}
+    try:
+        for f in fragments:
+            tc = task_counts[f.id]
+            nparts = consumer_tasks.get(f.id, 1)
+            upstream = {
+                src: {"dirs": committed[src],
+                      "merge": output_kinds[src] == "MERGE"}
+                for src in f.source_fragments
+            }
 
-        def run_attempt(task_index: int) -> SpoolBuffer:
-            clients = {}
-            for src in f.source_fragments:
-                if output_kinds[src] == "MERGE":
-                    clients[src] = [
-                        SpooledExchangeClient([s], task_index)
-                        for s in spools[src]
-                    ]
-                else:
-                    clients[src] = SpooledExchangeClient(
-                        spools[src], task_index)
-            planner = LocalPlanner(
-                runner.catalog,
-                splits_per_node=session.splits_per_node,
-                node_count=runner.worker_count,
-                task_index=task_index,
-                task_count=tc,
-                remote_clients=clients,
-                dynamic_filtering=session.dynamic_filtering,
-                hbm_limit_bytes=session.hbm_limit_bytes,
-            )
-            local = planner.plan(f.root)
-            buf = SpoolBuffer(nparts)
-            sink = PartitionedOutputSink(
-                buf, f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
-                f.output_keys, serde=session.exchange_serde)
-            local.pipelines[-1][-1] = sink
-            stats = None
-            if stats_sink is not None:
-                stats = QueryStats(
-                    label=f"fragment {f.id} task {task_index}:")
-            run_pipelines(local.pipelines, stats)
-            if stats is not None:
-                stats_sink.append(stats)
-            return buf
+            frag_commits: list[Optional[str]] = [None] * tc
+            failures: list[Optional[TaskFailure]] = [None] * tc
 
-        # stage barrier between fragments, but a stage's tasks still run
-        # concurrently (matching Trino FTE's intra-stage parallelism)
-        frag_spools: list[Optional[SpoolBuffer]] = [None] * tc
-        failures: list[Optional[TaskFailure]] = [None] * tc
+            def run_with_retry(t: int) -> None:
+                last: Optional[Exception] = None
+                for attempt in range(attempts_allowed):
+                    try:
+                        frag_commits[t] = runner.fte_run_attempt(
+                            f, t, tc, nparts, upstream, spool_root,
+                            attempt, stats_sink)
+                        return
+                    except Exception as e:  # retried; interrupts propagate
+                        last = e
+                        time.sleep(0.01 * attempt)
+                failures[t] = TaskFailure(f.id, t, attempts_allowed, last)
 
-        def run_with_retry(t: int) -> None:
-            last: Optional[Exception] = None
-            for attempt in range(attempts_allowed):
-                try:
-                    frag_spools[t] = run_attempt(t)
-                    return
-                except Exception as e:  # retried; interrupts propagate
-                    last = e
-                    time.sleep(0.01 * attempt)
-            failures[t] = TaskFailure(f.id, t, attempts_allowed, last)
+            # stage barrier between fragments, but a stage's tasks still run
+            # concurrently (matching Trino FTE's intra-stage parallelism)
+            threads = [threading.Thread(target=run_with_retry, args=(t,),
+                                        name=f"fte-{f.id}.{t}", daemon=True)
+                       for t in range(tc)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            for fail in failures:
+                if fail is not None:
+                    raise fail
+            committed[f.id] = [d for d in frag_commits if d is not None]
 
-        threads = [threading.Thread(target=run_with_retry, args=(t,),
-                                    name=f"fte-{f.id}.{t}", daemon=True)
-                   for t in range(tc)]
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join()
-        for fail in failures:
-            if fail is not None:
-                raise fail
-        spools[f.id] = frag_spools
+        from .durable_spool import DurableSpoolClient
 
-    root = spools[subplan.fragment.id]
-    out = []
-    for s in root:
-        for page in s.pages[0]:
+        client = DurableSpoolClient(committed[subplan.fragment.id], 0)
+        out = []
+        while True:
+            page = client.poll()
+            if page is None:
+                break
             out.append(maybe_deserialize(page))
-    return out
+        return out
+    finally:
+        shutil.rmtree(spool_root, ignore_errors=True)

@@ -1,0 +1,348 @@
+"""Coordinator-side remote execution: worker processes over HTTP.
+
+The process/network boundary of VERDICT round-3 item #3: the coordinator
+spawns N worker processes (execution/worker.py), mirrors each task with an
+:class:`HttpRemoteTask` (reference: server/remotetask/HttpRemoteTask.java:132
+— create POST, status polling, cancel), and pages move worker->worker and
+worker->coordinator through :class:`HttpExchangeClient` speaking the
+pull-token results protocol (operator/HttpPageBufferClient.java:355,
+operator/DirectExchangeClient.java:56).
+
+``ProcessDistributedQueryRunner`` keeps the in-process
+``DistributedQueryRunner`` planning/DDL surface and swaps the execution
+backend: every fragment task runs in a real worker process; killing a
+worker kills its tasks for real (the FTE recovery story becomes testable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..runner import QueryResult, Session
+from ..spi.batch import ColumnBatch
+from .distributed_runner import DistributedQueryRunner
+from .fragmenter import SubPlan
+from .serde import deserialize_batch
+from .worker import encode_descriptor
+
+__all__ = ["HttpExchangeClient", "HttpRemoteTask",
+           "ProcessDistributedQueryRunner", "WorkerProcess"]
+
+
+def _http(method: str, url: str, data: Optional[bytes] = None,
+          timeout: float = 30.0):
+    req = urllib.request.Request(url, data=data, method=method)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class HttpExchangeClient:
+    """Pulls one partition from many upstream task result URIs; same
+    poll/is_finished surface as the in-process ExchangeClient so operators
+    are transport-agnostic."""
+
+    def __init__(self, task_uris: list[str], partition: int):
+        # [uri, token, done]
+        self._sources = [[u, 0, False] for u in task_uris]
+        self.partition = partition
+        self._ready: list[ColumnBatch] = []
+
+    def _fetch(self, s, timeout: float) -> int:
+        uri, token, _done = s
+        url = f"{uri}/results/{self.partition}/{token}"
+        try:
+            with _http("GET", url, timeout=max(timeout, 5.0)) as resp:
+                body = resp.read()
+                next_token = int(resp.headers.get("X-Next-Token", token))
+                done = bool(int(resp.headers.get("X-Done", 0)))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:  # task not created yet: transient
+                return 0
+            raise RuntimeError(
+                f"exchange fetch failed ({e.code}): "
+                f"{e.read()[:500]!r}") from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            # worker gone: the coordinator's status poller decides whether
+            # this is fatal; treat as no-progress here
+            s[2] = getattr(self, "_fail_fast", False)
+            if s[2]:
+                raise RuntimeError(f"exchange source unreachable: {e}") from e
+            return 0
+        count = 0
+        pos = 0
+        while pos + 4 <= len(body):
+            (n,) = struct.unpack("<I", body[pos:pos + 4])
+            pos += 4
+            self._ready.append(deserialize_batch(body[pos:pos + n]))
+            pos += n
+            count += 1
+        s[1] = next_token
+        s[2] = done
+        return count
+
+    def poll(self, timeout: float = 0.05) -> Optional[ColumnBatch]:
+        if self._ready:
+            return self._ready.pop(0)
+        for s in self._sources:
+            if s[2]:
+                continue
+            if self._fetch(s, timeout):
+                return self._ready.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return not self._ready and all(done for _, _, done in self._sources)
+
+
+class HttpRemoteTask:
+    """Coordinator-side mirror of one worker task."""
+
+    def __init__(self, worker_url: str, task_id: str):
+        self.worker_url = worker_url
+        self.task_id = task_id
+        self.uri = f"{worker_url}/v1/task/{task_id}"
+
+    def create(self, descriptor: dict) -> None:
+        with _http("POST", self.uri, encode_descriptor(descriptor),
+                   timeout=60.0) as resp:
+            assert resp.status == 200
+
+    def status(self) -> dict:
+        try:
+            with _http("GET", f"{self.uri}/status", timeout=10.0) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, ConnectionError) as e:
+            return {"state": "GONE", "error": str(e)}
+
+    def cancel(self) -> None:
+        try:
+            _http("DELETE", self.uri, timeout=5.0).read()
+        except Exception:
+            pass
+
+
+class WorkerProcess:
+    """One spawned worker (python -m trino_tpu.execution.worker)."""
+
+    def __init__(self, env_overrides: Optional[dict] = None):
+        env = dict(os.environ)
+        env.update(env_overrides or {})
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "trino_tpu.execution.worker", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        line = self.proc.stdout.readline()
+        if not line.startswith("LISTENING"):
+            raise RuntimeError(f"worker failed to boot: {line!r}")
+        self.port = int(line.split()[1])
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        self.proc.kill()
+        self.proc.wait(timeout=10)
+
+    def shutdown(self) -> None:
+        try:
+            _http("PUT", f"{self.url}/v1/shutdown", timeout=5.0).read()
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.kill()
+
+
+class ProcessDistributedQueryRunner(DistributedQueryRunner):
+    """DistributedQueryRunner whose tasks run in real worker processes.
+
+    ``catalog_spec`` = {"factory": "module:callable", "kwargs": {...}}
+    reconstructs the catalog inside each worker (split generation is
+    worker-side; only plan fragments and pages cross the wire)."""
+
+    def __init__(self, catalog_spec: dict, worker_count: int = 2,
+                 session: Optional[Session] = None,
+                 env_overrides: Optional[dict] = None):
+        from .worker import build_catalog
+
+        super().__init__(build_catalog(catalog_spec),
+                         worker_count=worker_count, session=session)
+        self.catalog_spec = catalog_spec
+        self.workers = [WorkerProcess(env_overrides)
+                        for _ in range(worker_count)]
+        self._query_seq = 0
+
+    def close(self) -> None:
+        for w in self.workers:
+            w.shutdown()
+
+    def __del__(self):  # best effort
+        try:
+            for w in self.workers:
+                if w.alive():
+                    w.proc.kill()
+        except Exception:
+            pass
+
+    def fte_run_attempt(self, fragment, task_index: int, task_count: int,
+                        nparts: int, upstream: dict, spool_root: str,
+                        attempt: int, stats_sink: Optional[list]) -> str:
+        """Dispatch ONE FTE task attempt to a live worker PROCESS; the
+        worker writes the durable spool (shared filesystem) and commits
+        atomically.  A worker death mid-attempt surfaces here as GONE and
+        the FTE retry loop re-dispatches to a surviving worker — recovery
+        from real process loss, off the committed on-disk spools."""
+        import os as _os
+
+        from .fte import fte_task_dir
+
+        alive = [w for w in self.workers if w.alive()]
+        if not alive:
+            raise RuntimeError("no live workers")
+        w = alive[(fragment.id * 31 + task_index + attempt) % len(alive)]
+        self._query_seq += 1
+        task_dir = fte_task_dir(spool_root, fragment.id, task_index)
+        _os.makedirs(task_dir, exist_ok=True)
+        injector = getattr(self.session, "failure_injector", None)
+        desc = {
+            "fragment": fragment,
+            "task_index": task_index,
+            "task_count": task_count,
+            "num_partitions": nparts,
+            "upstream": {},
+            "catalog": self.catalog_spec,
+            "splits_per_node": self.session.splits_per_node,
+            "node_count": self.worker_count,
+            "dynamic_filtering": self.session.dynamic_filtering,
+            "hbm_limit_bytes": self.session.hbm_limit_bytes,
+            "spool": {"task_dir": task_dir, "attempt": attempt,
+                      "num_partitions": nparts},
+            "spool_upstream": upstream,
+            "failure_rules": (
+                injector.consume_for(fragment.id, task_index, attempt)
+                if injector is not None else []),
+        }
+        rt = HttpRemoteTask(
+            w.url, f"fte{self._query_seq}_f{fragment.id}_t{task_index}"
+                   f"_a{attempt}")
+        rt.create(desc)
+        deadline = time.monotonic() + 600
+        while True:
+            st = rt.status()
+            if st["state"] == "FINISHED":
+                break
+            if st["state"] in ("FAILED", "GONE", "CANCELED"):
+                raise RuntimeError(
+                    f"attempt failed ({st['state']}): {st.get('error')}")
+            if time.monotonic() > deadline:
+                rt.cancel()
+                raise TimeoutError("fte attempt stalled")
+            time.sleep(0.05)
+        expected = _os.path.join(task_dir, f"attempt-{attempt}")
+        if not _os.path.isdir(expected):
+            raise RuntimeError("attempt reported FINISHED but no committed "
+                               "spool found")
+        if stats_sink is not None:
+            from ..exec.stats import QueryStats
+
+            stats_sink.append(QueryStats(
+                label=f"fragment {fragment.id} task {task_index}: "
+                      f"(remote worker {w.url})"))
+        return expected
+
+    # ------------------------------------------------------------- execution
+    def _execute_subplan(self, subplan: SubPlan,
+                         stats_sink: Optional[list]) -> QueryResult:
+        if self.session.retry_policy == "TASK":
+            from .fte import run_fte_query
+
+            return self._to_result(
+                subplan, run_fte_query(self, subplan, stats_sink))
+        return self._run_remote(subplan)
+
+    def _run_remote(self, subplan: SubPlan) -> QueryResult:
+        self._query_seq += 1
+        qid = f"pq{self._query_seq}"
+        fragments = subplan.all_fragments()
+        task_counts, consumer_tasks = self.stage_task_counts(fragments)
+        alive = [w for w in self.workers if w.alive()]
+        if not alive:
+            raise RuntimeError("no live workers")
+
+        # deterministic placement: task t of fragment f -> alive worker
+        # (f*31 + t) % n  (UniformNodeSelector's role, minus locality)
+        tasks: dict[tuple[int, int], HttpRemoteTask] = {}
+        for f in fragments:
+            for t in range(task_counts[f.id]):
+                w = alive[(f.id * 31 + t) % len(alive)]
+                tasks[(f.id, t)] = HttpRemoteTask(w.url, f"{qid}_f{f.id}_t{t}")
+
+        by_id = {f.id: f for f in fragments}
+        for f in fragments:
+            tc = task_counts[f.id]
+            for t in range(tc):
+                upstream = {}
+                for src in f.source_fragments:
+                    src_tasks = [tasks[(src, i)].uri
+                                 for i in range(task_counts[src])]
+                    upstream[src] = {
+                        "uris": src_tasks,
+                        "merge": by_id[src].output_kind == "MERGE",
+                    }
+                desc = {
+                    "fragment": f,
+                    "task_index": t,
+                    "task_count": tc,
+                    "num_partitions": consumer_tasks.get(f.id, 1),
+                    "upstream": upstream,
+                    "catalog": self.catalog_spec,
+                    "splits_per_node": self.session.splits_per_node,
+                    "node_count": self.worker_count,
+                    "dynamic_filtering": self.session.dynamic_filtering,
+                    "hbm_limit_bytes": self.session.hbm_limit_bytes,
+                }
+                tasks[(f.id, t)].create(desc)
+
+        # drain the root fragment's partition 0 as the client, watching
+        # task statuses (fail fast on any FAILED task)
+        root = subplan.fragment
+        root_uris = [tasks[(root.id, t)].uri
+                     for t in range(task_counts[root.id])]
+        client = HttpExchangeClient(root_uris, 0)
+        batches: list[ColumnBatch] = []
+        deadline = time.monotonic() + 600
+        last_status = 0.0
+        try:
+            while not client.is_finished():
+                b = client.poll(timeout=0.2)
+                if b is not None:
+                    batches.append(b)
+                    continue
+                now = time.monotonic()
+                if now - last_status > 1.0:
+                    last_status = now
+                    for (fid, t), rt in tasks.items():
+                        st = rt.status()
+                        if st["state"] in ("FAILED", "GONE"):
+                            raise RuntimeError(
+                                f"task f{fid}.t{t} {st['state']}: "
+                                f"{st.get('error')}")
+                if now > deadline:
+                    raise TimeoutError("remote query stalled")
+        except BaseException:
+            for rt in tasks.values():
+                rt.cancel()
+            raise
+        return self._to_result(subplan, batches)

@@ -1,0 +1,358 @@
+"""Worker process: the engine's task control + data plane over HTTP.
+
+The real process boundary the round-3 engine lacked (VERDICT item #3).
+Mirrors the reference's worker surface (reference:
+core/trino-main/src/main/java/io/trino/server/TaskResource.java):
+
+- ``POST /v1/task/{task_id}``   create + start a task (TaskResource.java:140)
+- ``GET  /v1/task/{task_id}/results/{buffer_id}/{token}``   pull-token page
+  stream; a read at token T implicitly acks every earlier page
+  (TaskResource.java:333, execution/buffer/ClientBuffer.java:318)
+- ``GET  /v1/task/{task_id}/status``   long-pollable task state
+- ``DELETE /v1/task/{task_id}``   cancel/abort (TaskResource.java:294)
+- ``GET  /v1/info``   node liveness (the heartbeat target)
+- ``PUT  /v1/shutdown``   graceful drain-and-exit
+  (server/GracefulShutdownHandler.java:42)
+
+The task descriptor travels as a zlib-compressed pickle (the trust domain is
+the cluster's own coordinator, matching the reference's JSON-over-HTTP
+between mutually-trusted nodes); pages travel as the serde wire format
+(execution/serde.py — PageSerializer.java:58's role).
+
+Run as ``python -m trino_tpu.execution.worker --port 0``; prints
+``LISTENING <port>`` on stdout when ready.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import pickle
+import sys
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+__all__ = ["TaskServer", "encode_descriptor", "decode_descriptor", "main"]
+
+
+def encode_descriptor(desc: dict) -> bytes:
+    return zlib.compress(pickle.dumps(desc), level=1)
+
+
+def decode_descriptor(data: bytes) -> dict:
+    return pickle.loads(zlib.decompress(data))
+
+
+def build_catalog(spec: dict):
+    """spec: {"factory": "module:callable", "kwargs": {...}} — the worker
+    reconstructs its catalog locally (split generation happens worker-side;
+    only control metadata crosses the wire)."""
+    mod, fn = spec["factory"].split(":")
+    factory = getattr(importlib.import_module(mod), fn)
+    return factory(**spec.get("kwargs", {}))
+
+
+class _Task:
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.state = "RUNNING"
+        self.error: Optional[str] = None
+        self.buffer = None  # OutputBuffer, set when planning completes
+        self.ready = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+
+
+class TaskServer:
+    def __init__(self, port: int = 0):
+        self.tasks: dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        self._draining = False
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes = b"",
+                      content_type: str = "application/json",
+                      headers: Optional[dict] = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    server._get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode())
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                try:
+                    server._post(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, json.dumps({"error": repr(e)}).encode())
+
+            def do_DELETE(self):
+                try:
+                    server._delete(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, json.dumps({"error": repr(e)}).encode())
+
+            def do_PUT(self):
+                try:
+                    server._put(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, json.dumps({"error": repr(e)}).encode())
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+
+    # ------------------------------------------------------------ handlers
+    def _get(self, h) -> None:
+        parts = [p for p in h.path.split("/") if p]
+        if parts == ["v1", "info"]:
+            h._send(200, json.dumps({
+                "state": "SHUTTING_DOWN" if self._draining else "ACTIVE",
+                "tasks": len(self.tasks)}).encode())
+            return
+        if len(parts) == 4 and parts[:2] == ["v1", "task"] and \
+                parts[3] == "status":
+            t = self.tasks.get(parts[2])
+            if t is None:
+                h._send(404, b'{"error": "no such task"}')
+                return
+            h._send(200, json.dumps(
+                {"state": t.state, "error": t.error}).encode())
+            return
+        if len(parts) == 6 and parts[:2] == ["v1", "task"] and \
+                parts[3] == "results":
+            self._get_results(h, parts[2], int(parts[4]), int(parts[5]))
+            return
+        h._send(404, b'{"error": "not found"}')
+
+    def _get_results(self, h, task_id: str, buffer_id: int,
+                     token: int) -> None:
+        """Pull-token page read (TaskResource.getResults equivalent): body
+        is length-prefixed serde frames; X-Next-Token / X-Done carry the
+        protocol state."""
+        import struct
+
+        t = self.tasks.get(task_id)
+        if t is None:
+            h._send(404, b'{"error": "no such task"}')
+            return
+        if t.state == "FAILED":
+            h._send(500, json.dumps({"error": t.error}).encode())
+            return
+        if not t.ready.wait(timeout=5.0) or t.buffer is None:
+            h._send(200, b"", "application/x-trino-pages",
+                    {"X-Next-Token": token, "X-Done": 0})
+            return
+        pages, next_token, done = t.buffer.get(buffer_id, token, timeout=1.0)
+        body = bytearray()
+        for p in pages:
+            raw = p.data if hasattr(p, "data") else None
+            if raw is None:  # unserialized batch (non-serde sink): encode
+                from .serde import serialize_batch
+
+                raw = serialize_batch(p)
+            body += struct.pack("<I", len(raw))
+            body += raw
+        h._send(200, bytes(body), "application/x-trino-pages",
+                {"X-Next-Token": next_token, "X-Done": int(done)})
+
+    def _post(self, h) -> None:
+        parts = [p for p in h.path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            if self._draining:
+                h._send(503, b'{"error": "shutting down"}')
+                return
+            n = int(h.headers.get("Content-Length", 0))
+            desc = decode_descriptor(h.rfile.read(n))
+            task_id = parts[2]
+            with self._lock:
+                if task_id in self.tasks:
+                    h._send(200, b'{"state": "RUNNING"}')
+                    return
+                t = _Task(task_id)
+                self.tasks[task_id] = t
+            t.thread = threading.Thread(
+                target=self._run_task, args=(t, desc), daemon=True,
+                name=f"task-{task_id}")
+            t.thread.start()
+            h._send(200, b'{"state": "RUNNING"}')
+            return
+        h._send(404, b'{"error": "not found"}')
+
+    def _delete(self, h) -> None:
+        parts = [p for p in h.path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            t = self.tasks.get(parts[2])
+            if t is not None:
+                if t.buffer is not None:
+                    t.buffer.abort()
+                t.state = "CANCELED" if t.state == "RUNNING" else t.state
+                h._send(200, b'{"state": "CANCELED"}')
+                return
+        h._send(404, b'{"error": "not found"}')
+
+    def _put(self, h) -> None:
+        parts = [p for p in h.path.split("/") if p]
+        if parts == ["v1", "shutdown"]:
+            # graceful drain: refuse new tasks, exit once current ones end
+            self._draining = True
+            h._send(200, b'{"state": "SHUTTING_DOWN"}')
+            threading.Thread(target=self._drain_and_exit, daemon=True).start()
+            return
+        h._send(404, b'{"error": "not found"}')
+
+    def _drain_and_exit(self) -> None:
+        import time
+
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if all(t.state != "RUNNING" for t in self.tasks.values()):
+                break
+            time.sleep(0.1)
+        self.httpd.shutdown()
+
+    # ------------------------------------------------------------ execution
+    def _run_task(self, t: _Task, desc: dict) -> None:
+        writer = None
+        try:
+            from ..exec.driver import run_pipelines
+            from ..exec.local_planner import LocalPlanner
+            from .durable_spool import DurableSpoolClient, DurableSpoolWriter
+            from .exchange import OutputBuffer
+            from .failure_injector import (
+                GET_RESULTS_FAILURE,
+                PROCESS_EXIT,
+                TASK_FAILURE,
+                InjectedFailure,
+                check_wire_rules,
+            )
+            from .remote import HttpExchangeClient
+            from .task import PartitionedOutputSink
+
+            catalog = build_catalog(desc["catalog"])
+            fragment = desc["fragment"]
+            task_index = desc["task_index"]
+            attempt = desc.get("spool", {}).get("attempt", 0)
+            rules = desc.get("failure_rules", [])
+            if check_wire_rules(rules, PROCESS_EXIT, fragment.id,
+                                task_index, attempt):
+                # the real "node died" case: kill the whole worker process
+                import os as _os
+
+                _os._exit(17)
+            if check_wire_rules(rules, TASK_FAILURE, fragment.id,
+                                task_index, attempt):
+                raise InjectedFailure(
+                    f"injected TASK_FAILURE f{fragment.id}.t{task_index} "
+                    f"attempt {attempt}")
+
+            clients = {}
+            if "spool_upstream" in desc and desc["spool_upstream"]:
+                def on_read(_d, _f=fragment.id, _t=task_index, _a=attempt):
+                    if check_wire_rules(rules, GET_RESULTS_FAILURE, _f, _t,
+                                        _a):
+                        raise InjectedFailure("injected GET_RESULTS_FAILURE")
+
+                for src_id, info in desc["spool_upstream"].items():
+                    if info.get("merge"):
+                        clients[src_id] = [
+                            DurableSpoolClient([d], task_index, on_read)
+                            for d in info["dirs"]
+                        ]
+                    else:
+                        clients[src_id] = DurableSpoolClient(
+                            info["dirs"], task_index, on_read)
+            for src_id, info in desc.get("upstream", {}).items():
+                uris = info["uris"]
+                if info.get("merge"):
+                    clients[src_id] = [
+                        HttpExchangeClient([u], task_index) for u in uris
+                    ]
+                else:
+                    clients[src_id] = HttpExchangeClient(uris, task_index)
+            planner = LocalPlanner(
+                catalog,
+                splits_per_node=desc.get("splits_per_node", 4),
+                node_count=desc.get("node_count", 1),
+                task_index=task_index,
+                task_count=desc["task_count"],
+                remote_clients=clients,
+                dynamic_filtering=desc.get("dynamic_filtering", True),
+                hbm_limit_bytes=desc.get("hbm_limit_bytes", 16 << 30),
+            )
+            local = planner.plan(fragment.root)
+            if "spool" in desc:  # FTE: durable on-disk attempt spool
+                sp = desc["spool"]
+                writer = DurableSpoolWriter(
+                    sp["task_dir"], sp["attempt"], sp["num_partitions"])
+                out = writer
+            else:
+                out = OutputBuffer(desc["num_partitions"])
+            sink = PartitionedOutputSink(
+                out,
+                fragment.output_kind if fragment.output_kind != "OUTPUT"
+                else "GATHER",
+                fragment.output_keys, serde=True)
+            local.pipelines[-1][-1] = sink
+            if writer is None:
+                t.buffer = out
+            t.ready.set()
+            run_pipelines(local.pipelines)
+            t.state = "FINISHED"
+        except BaseException as e:  # noqa: BLE001 — reported to coordinator
+            t.error = f"{type(e).__name__}: {e}"
+            t.state = "FAILED"
+            if t.buffer is not None:
+                t.buffer.abort()
+            if writer is not None:
+                writer.abort()
+            t.ready.set()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    # the sitecustomize-preloaded jax ignores late env platform selection;
+    # apply it through the config API before any backend use
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    server = TaskServer(args.port)
+    print(f"LISTENING {server.port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

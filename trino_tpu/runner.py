@@ -244,6 +244,11 @@ class Session:
     # active transaction (execution/transaction.py); None = autocommit
     transaction: object = None
     _transaction_manager: object = None
+    # engine-level failure injection (execution/failure_injector.py;
+    # reference: execution/FailureInjector.java:35)
+    failure_injector: object = None
+    # base directory for the durable FTE spool (None = system temp)
+    fte_spool_dir: object = None
     # INSERT/CTAS fan out over round-robin writer tasks when the source is
     # large (SCALED_WRITER_* partitionings in miniature; planned by estimate)
     scale_writers: bool = False

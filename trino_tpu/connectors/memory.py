@@ -67,7 +67,12 @@ def _batch_overlaps(b: ColumnBatch, constraint) -> bool:
                 present = data if valid is None else data[valid]
                 if present.size:
                     vals = c.dictionary[np.unique(present)]
-                    stats[name] = (str(vals[0]), str(vals[-1]), has_null)
+                    # long-decimal dictionaries hold python ints: zone-map
+                    # bounds must stay in storage space, not stringify
+                    if isinstance(vals[0], int):
+                        stats[name] = (int(vals[0]), int(vals[-1]), has_null)
+                    else:
+                        stats[name] = (str(vals[0]), str(vals[-1]), has_null)
                 else:
                     stats[name] = (None, None, has_null)
             elif np.issubdtype(data.dtype, np.number) or data.dtype == bool:

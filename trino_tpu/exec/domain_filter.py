@@ -60,7 +60,9 @@ def tuple_domain_mask(batch: ColumnBatch, constraint: TupleDomain,
             tab = dict_cache.get(ck) if dict_cache is not None else None
             if tab is None:
                 tab = np.array(
-                    [dom.values.contains_value(str(v)) for v in c.dictionary],
+                    [dom.values.contains_value(
+                        int(v) if isinstance(v, int) else str(v))
+                     for v in c.dictionary],
                     dtype=bool)
                 if dict_cache is not None:
                     dict_cache[ck] = tab

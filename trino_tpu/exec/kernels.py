@@ -425,6 +425,37 @@ def group_ids_codes(key_cols, live):
     return perm, gid, total, presence, keys_out
 
 
+_LIMB_BASE = 1 << 31
+_LIMB_COUNT = 5  # 5x31 bits = 155 > 127-bit magnitude; +1 sign limb
+
+
+def decimal_limb_tables(dictionary) -> list[np.ndarray]:
+    """Long-decimal dictionary (python scaled ints) -> 6 int64 limb tables:
+    value = sum(limb_k * 2^(31k)) + sign_limb * 2^155.  Each limb is in
+    [0, 2^31) (sign limb in {-1, 0}), so per-group int64 sums stay exact
+    for up to 2^31 rows — the engine's Int128Math.java: exact wide-decimal
+    SUM/AVG runs as ordinary int64 vector sums over limb planes, recombined
+    with python bignums per group (spi/type/Int128Math.java's role)."""
+    n = len(dictionary)
+    tabs = [np.empty(n, np.int64) for _ in range(_LIMB_COUNT + 1)]
+    for i, v in enumerate(dictionary):
+        x = int(v)
+        for k in range(_LIMB_COUNT):
+            x, r = divmod(x, _LIMB_BASE)
+            tabs[k][i] = r
+        tabs[_LIMB_COUNT][i] = x  # 0 or -1
+    return tabs
+
+
+def combine_limb_sums(sums) -> int:
+    """Per-group limb sums (python ints) -> exact scaled-int total."""
+    total = 0
+    for k in range(_LIMB_COUNT):
+        total += int(sums[k]) << (31 * k)
+    total += int(sums[_LIMB_COUNT]) << (31 * _LIMB_COUNT)
+    return total
+
+
 _SENTINELS = {
     "min": {
         "i": lambda dt: jnp.iinfo(dt).max,

@@ -731,8 +731,36 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         # kernel specs; avg expands to (sum, count) state pairs, the variance
         # family to (sum, sumsq, count) triples.  FINAL merges partial
         # states: count -> sum of counts, others same fn.
-        specs, avg_slots, stat_slots = [], {}, {}
+        specs, avg_slots, stat_slots, ld_slots = [], {}, {}, {}
+
+        def _long_dec_col(arg: int):
+            if arg < 0:
+                return None
+            c = inp.columns[arg]
+            t = c.type
+            if isinstance(t, DecimalType) and t.precision > 18:
+                return c
+            return None
+
         for idx, a in enumerate(self.aggs):
+            ld_col = (_long_dec_col(a.arg)
+                      if a.fn in ("sum", "avg") else None)
+            if ld_col is not None:
+                # exact wide-decimal SUM/AVG: int64 limb-plane sums on
+                # device, bignum recombination per group on host
+                # (kernels.decimal_limb_tables; Int128Math.java's role)
+                if a.distinct:
+                    raise NotImplementedError(
+                        "DISTINCT long-decimal aggregate")
+                ld_slots[idx] = a.fn
+                valid_f = fold_live(ld_col.valid)
+                codes_dev = jnp.asarray(ld_col.data)
+                for tab in K.decimal_limb_tables(ld_col.dictionary):
+                    specs.append(("sum", jnp.asarray(tab)[codes_dev],
+                                  valid_f, np.int64, False))
+                specs.append(("count", ld_col.data, valid_f, np.int64,
+                              False))
+                continue
             if self.step == "FINAL":
                 c = inp.columns[a.arg]
                 data, valid = c.data, fold_live(c.valid)
@@ -789,12 +817,17 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         arrays: list = []
         col_types: list = []
         col_dicts: list = []
+        order: list = []  # ("prog",) | ("host", Column) in output position
 
         def emit(entry, srcs, t, dict_=None):
             plan.append(entry)
             arrays.extend(srcs)
             col_types.append(t)
             col_dicts.append(dict_)
+            order.append(("prog", None))
+
+        def emit_host(column):
+            order.append(("host", column))
 
         for (d, v), c in zip(keys_out, key_cols):
             emit(("copy", None, v is not None),
@@ -803,6 +836,48 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         ncols = nk
         for idx, a in enumerate(self.aggs):
             t = self.output_types[ncols]
+            if idx in ld_slots:
+                # exact wide-decimal finalize: pull the tiny per-group limb
+                # sums (+count) in ONE round trip, recombine with bignums
+                fnname = ld_slots[idx]
+                limbs = reduced[ri:ri + 6]
+                cnt_res = reduced[ri + 7 - 1]
+                ri += 7
+                pulled = jax.device_get(
+                    [d for d, _ in limbs] + [cnt_res[0]])
+                counts = np.asarray(pulled[-1])
+                src_scale = 0
+                if a.arg >= 0:
+                    src_t = inp.columns[a.arg].type
+                    if isinstance(src_t, DecimalType):
+                        src_scale = src_t.scale
+                import decimal as _dec
+
+                values: list = []
+                for g in range(num_groups):
+                    if int(counts[g]) == 0:
+                        values.append(None)
+                        continue
+                    total = K.combine_limb_sums(
+                        [p[g] for p in pulled[:6]])
+                    if fnname == "avg":
+                        with _dec.localcontext() as ctx:
+                            ctx.prec = 80
+                            q = (_dec.Decimal(total).scaleb(-src_scale)
+                                 / int(counts[g]))
+                            values.append(int(q.scaleb(t.scale).quantize(
+                                0, rounding=_dec.ROUND_HALF_UP)))
+                    else:
+                        from ..spi.batch import rescale_scaled_int
+
+                        values.append(rescale_scaled_int(
+                            total, src_scale, t.scale))
+                from ..spi.batch import encode_sorted_objects
+
+                codes, valid, dict_ = encode_sorted_objects(values, 0)
+                emit_host(Column(t, codes, valid, dict_))
+                ncols += 1
+                continue
             if idx in avg_slots:
                 s_data, s_valid = reduced[ri]
                 c_data, _ = reduced[ri + 1]
@@ -857,9 +932,16 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
             emit(("copy", np.dtype(t.storage_dtype).str, v is not None),
                  [d] + ([v] if v is not None else []), t, dict_)
             ncols += 1
-        outs = K.finalize_groups(plan, arrays)
-        out_cols = [Column(t, d, v, dc)
-                    for (d, v), t, dc in zip(outs, col_types, col_dicts)]
+        outs = iter(K.finalize_groups(plan, arrays)) if plan else iter([])
+        prog_meta = iter(zip(col_types, col_dicts))
+        out_cols = []
+        for kind, payload in order:
+            if kind == "host":
+                out_cols.append(payload)
+            else:
+                d, v = next(outs)
+                t, dc = next(prog_meta)
+                out_cols.append(Column(t, d, v, dc))
         return ColumnBatch(self.output_names, out_cols, presence)
 
     def get_output(self) -> Optional[ColumnBatch]:

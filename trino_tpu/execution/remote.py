@@ -69,12 +69,10 @@ class HttpExchangeClient:
             raise RuntimeError(
                 f"exchange fetch failed ({e.code}): "
                 f"{e.read()[:500]!r}") from e
-        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
-            # worker gone: the coordinator's status poller decides whether
-            # this is fatal; treat as no-progress here
-            s[2] = getattr(self, "_fail_fast", False)
-            if s[2]:
-                raise RuntimeError(f"exchange source unreachable: {e}") from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError):
+            # worker unreachable: no-progress here; the coordinator's task
+            # status sweep decides whether the producer is GONE and fails
+            # the query (HttpPageBufferClient's backoff role)
             return 0
         count = 0
         pos = 0

@@ -99,7 +99,10 @@ def serialize_batch(batch: ColumnBatch, codec: int = CODEC_ZLIB) -> bytes:
             parts.append(b"\x01")
             parts.append(struct.pack("<I", len(col.dictionary)))
             for v in col.dictionary:
-                _pack_str(parts, str(v))
+                # tuples (array/row/map) and python ints (long decimals)
+                # round-trip through repr; strings stay plain
+                _pack_str(parts, repr(v) if isinstance(v, (tuple, int))
+                          else str(v))
         else:
             parts.append(b"\x00")
     payload = b"".join(parts)
@@ -130,7 +133,20 @@ def deserialize_batch(data: bytes) -> ColumnBatch:
         dictionary = None
         if r.take(1) == b"\x01":
             count = r.u32()
-            dictionary = np.array([r.text() for _ in range(count)],
-                                  dtype=object)
+            texts = [r.text() for _ in range(count)]
+            dictionary = np.empty(count, dtype=object)
+            from ..spi.types import ArrayType, DecimalType, MapType, RowType
+
+            if isinstance(type_, (ArrayType, RowType, MapType)):
+                import ast as _ast
+
+                for i, s in enumerate(texts):
+                    dictionary[i] = _ast.literal_eval(s)
+            elif isinstance(type_, DecimalType) and type_.precision > 18:
+                for i, s in enumerate(texts):
+                    dictionary[i] = int(s)
+            else:
+                for i, s in enumerate(texts):
+                    dictionary[i] = s
         cols.append(Column(type_, arr, valid, dictionary))
     return ColumnBatch(names, cols)

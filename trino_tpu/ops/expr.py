@@ -47,6 +47,7 @@ from ..spi.types import (
     Type,
     is_string,
 )
+from ..spi.batch import rescale_scaled_int
 from ..sql.ir import Call, InputRef, Literal, RowExpression
 
 __all__ = ["CompiledExpression", "compile_expression", "compile_projection"]
@@ -116,9 +117,118 @@ def _scale_of(t: Type) -> int:
     return t.scale if isinstance(t, DecimalType) else 0
 
 
+def _is_long_dec(t: Type) -> bool:
+    return isinstance(t, DecimalType) and t.precision > 18
+
+
+def _long_dec_transform(col: Lowered, pyfn, out_type: Type) -> Lowered:
+    """Exact host transform over a long-decimal dictionary (python ints);
+    ``pyfn`` returns a scaled int at out_type.scale or None (NULL, e.g.
+    division by zero).  Mirrors the string _dict_transform idiom —
+    spi/type/Int128Math.java's role is played by python bignums over the
+    (small) dictionary, never per row."""
+    vals = [pyfn(int(v)) for v in col.dictionary]
+    uniq = sorted({v for v in vals if v is not None} or {0})
+    pos = {v: i for i, v in enumerate(uniq)}
+    remap = np.array([pos.get(v, 0) for v in vals], dtype=np.int32)
+    entry_ok = np.array([v is not None for v in vals])
+    newdict = np.empty(len(uniq), dtype=object)
+    for i, v in enumerate(uniq):
+        newdict[i] = v
+    all_ok = bool(entry_ok.all())
+
+    def fn(cols: Cols):
+        codes, valid = col.fn(cols)
+        data = jnp.asarray(remap)[codes]
+        if not all_ok:
+            ok = jnp.asarray(entry_ok)[codes]
+            valid = ok if valid is None else (jnp.asarray(valid) & ok)
+        return data, valid
+
+    return Lowered(out_type, newdict, fn)
+
+
+def _long_dec_literal_value(x: Lowered):
+    """Scaled-int value of a long-decimal literal Lowered (or None)."""
+    if x.dictionary is not None and len(x.dictionary) == 1 and \
+            hasattr(x.fn, "_literal_value"):
+        return int(x.fn._literal_value)
+    return None
+
+
+def _long_arith_value(name: str, va, sa, vb, sb, os: int):
+    """Exact scaled-int arithmetic (python bignums), HALF_UP rounding.
+    Runs under an 80-digit context: the default 28-digit context would
+    silently round wide decimals."""
+    import decimal as _d
+
+    with _d.localcontext() as ctx:
+        ctx.prec = 80
+        return _long_arith_ctx(name, va, sa, vb, sb, os)
+
+
+def _long_arith_ctx(name: str, va, sa, vb, sb, os: int):
+    import decimal as _d
+
+    A = _d.Decimal(va).scaleb(-sa)
+    B = _d.Decimal(vb).scaleb(-sb)
+    if name == "add":
+        r = A + B
+    elif name == "subtract":
+        r = A - B
+    elif name == "multiply":
+        r = A * B
+    elif name == "divide":
+        if B == 0:
+            return None
+        r = A / B
+    else:  # modulus
+        if B == 0:
+            return None
+        r = A % B
+    return int(r.scaleb(os).quantize(0, rounding=_d.ROUND_HALF_UP))
+
+
 def _arith_handler(name: str):
     def handler(out_type: Type, args: list[Lowered]) -> Lowered:
         a, b = args
+        if _is_long_dec(a.type) or _is_long_dec(b.type) or _is_long_dec(out_type):
+            if getattr(a.fn, "_literal_null", False) or getattr(
+                    b.fn, "_literal_null", False):
+                # NULL operand: the whole expression is NULL (Trino
+                # three-valued arithmetic), no transform needed
+                d0 = None
+                if _is_long_dec(out_type):
+                    d0 = np.empty(1, dtype=object)
+                    d0[0] = 0
+
+                def fn_null(cols: Cols):
+                    return (jnp.zeros((), out_type.storage_dtype),
+                            jnp.zeros((), bool))
+
+                return Lowered(out_type, d0, fn_null)
+            os = _scale_of(out_type)
+            sa, sb = _scale_of(a.type), _scale_of(b.type)
+            la, lb = _long_dec_literal_value(a), _long_dec_literal_value(b)
+            # literal sides that are short decimals/integers also qualify
+            if la is None and hasattr(a.fn, "_literal_value") and not _is_long_dec(a.type):
+                la = int(a.fn._literal_value)
+            if lb is None and hasattr(b.fn, "_literal_value") and not _is_long_dec(b.type):
+                lb = int(b.fn._literal_value)
+            if _is_long_dec(a.type) and a.dictionary is not None and lb is not None:
+                out = _long_dec_transform(
+                    a, lambda v: _long_arith_value(name, v, sa, lb, sb, os),
+                    out_type)
+                return _and_extra_valid(out, [b])
+            if _is_long_dec(b.type) and b.dictionary is not None and la is not None:
+                out = _long_dec_transform(
+                    b, lambda v: _long_arith_value(name, la, sa, v, sb, os),
+                    out_type)
+                return _and_extra_valid(out, [a])
+            raise NotImplementedError(
+                "long-decimal arithmetic between two columns is not "
+                "supported (dictionary-encoded int128 path; rewrite with a "
+                "literal operand or cast to double)")
 
         def fn(cols: Cols):
             (av, avalid), (bv, bvalid) = a.fn(cols), b.fn(cols)
@@ -237,14 +347,23 @@ def _cmp_dict_literal(name: str, col: Lowered, lit_value: str):
 def _cmp_handler(name: str):
     def handler(out_type: Type, args: list[Lowered]) -> Lowered:
         a, b = args
-        is_arr = isinstance(a.type, ArrayType) or isinstance(b.type, ArrayType)
+        from ..spi.types import MapType, RowType
+
+        is_arr = any(isinstance(t, (ArrayType, RowType, MapType))
+                     for t in (a.type, b.type))
+        is_ldec = _is_long_dec(a.type) or _is_long_dec(b.type)
         if is_arr and name not in ("eq", "ne"):
-            raise NotImplementedError("array ordering comparison")
-        if is_string(a.type) or is_string(b.type) or is_arr:
-            # array dictionaries hold python tuples — comparable/sortable
-            # like strings, but never coerced through str()
+            raise NotImplementedError("array/row/map ordering comparison")
+        if is_string(a.type) or is_string(b.type) or is_arr or is_ldec:
+            # array/row/map dictionaries hold python tuples, long-decimal
+            # dictionaries hold python ints — comparable/sortable like
+            # strings, but never coerced through str()
             def lit(d):
-                return d[0] if is_arr else str(d[0])
+                if is_arr:
+                    return d[0]
+                if is_ldec:
+                    return int(d[0])
+                return str(d[0])
 
             # literal vs column: route through the sorted dictionary
             if b.dictionary is not None and len(b.dictionary) == 1 and a.dictionary is not None and len(a.dictionary) != 1:
@@ -465,8 +584,11 @@ def _like_handler(out_type, args):
     if col.dictionary is None or pat.dictionary is None or len(pat.dictionary) != 1:
         raise NotImplementedError("LIKE requires a dictionary column and literal pattern")
     escape = str(esc.dictionary[0]) if esc is not None and esc.dictionary is not None else None
-    rx = re.compile(like_to_regex(str(pat.dictionary[0]), escape), re.DOTALL)
-    mask = np.array([rx.fullmatch(str(v)) is not None for v in col.dictionary])
+    # bit-parallel NFA over the whole dictionary (ops/like_dfa.py — the
+    # DenseDfaMatcher.java:23 role); small dictionaries keep the re loop
+    from .like_dfa import like_mask
+
+    mask = like_mask(col.dictionary, str(pat.dictionary[0]), escape)
 
     def fn(cols: Cols):
         codes, valid = col.fn(cols)
@@ -814,6 +936,28 @@ def _truncate_handler(out_type, args):
 # CAST
 
 
+def _nested_repr_compatible(a: Type, b: Type) -> bool:
+    """True when two nested types share an identical python-value
+    representation in dictionaries (so codes can pass through a cast)."""
+    from ..spi.types import MapType, RowType
+
+    def kind(t: Type):
+        if isinstance(t, ArrayType):
+            return ("array", kind(t.element))
+        if isinstance(t, RowType):
+            return ("row", tuple(kind(ft) for _, ft in t.fields))
+        if isinstance(t, MapType):
+            return ("map", kind(t.key), kind(t.value))
+        if is_string(t):
+            return "str"
+        if isinstance(t, DecimalType):
+            return ("dec", t.scale)
+        k = np.dtype(t.storage_dtype).kind
+        return {"i": "int", "u": "int", "f": "float", "b": "bool"}.get(k, t.name)
+
+    return kind(a) == kind(b)
+
+
 def _cast_handler(out_type, args):
     (a,) = args
     src = a.type
@@ -821,6 +965,76 @@ def _cast_handler(out_type, args):
         return a
     if is_string(src) and is_string(out_type):
         return a
+    from ..spi.types import MapType, RowType
+
+    if isinstance(src, (ArrayType, RowType, MapType)) and isinstance(
+            out_type, (ArrayType, RowType, MapType)):
+        # nested casts pass codes through ONLY when the python-value
+        # representation is identical (same kind, matching element repr:
+        # named vs anonymous row fields, int-width changes); anything else
+        # (string->number elements, array->map) must not silently mistype
+        if _nested_repr_compatible(src, out_type):
+            return Lowered(out_type, a.dictionary, a.fn)
+        raise NotImplementedError(
+            f"cast {src} -> {out_type}: nested element conversion is not "
+            "supported")
+    ss, ds = _scale_of(src), _scale_of(out_type)
+    if _is_long_dec(out_type):
+        if _is_long_dec(src) and a.dictionary is not None:
+            return _long_dec_transform(
+                a, lambda v: rescale_scaled_int(v, ss, ds), out_type)
+        if is_string(src) and a.dictionary is not None:
+            # varchar -> decimal(38): exact parse over the dictionary
+            from ..spi.batch import _to_scaled_int
+
+            vals = [_to_scaled_int(str(v), ds) for v in a.dictionary]
+            uniq = sorted(set(vals))
+            pos = {v: i for i, v in enumerate(uniq)}
+            remap = np.array([pos[v] for v in vals], dtype=np.int32)
+            newdict = np.empty(len(uniq), dtype=object)
+            for i, v in enumerate(uniq):
+                newdict[i] = v
+
+            def fn_vd(cols: Cols):
+                codes, valid = a.fn(cols)
+                return jnp.asarray(remap)[codes], valid
+
+            return Lowered(out_type, newdict, fn_vd)
+        if hasattr(a.fn, "_literal_value"):
+            raw = rescale_scaled_int(int(a.fn._literal_value), ss, ds)
+            d = np.empty(1, dtype=object)
+            d[0] = raw
+
+            def fn_lit(cols: Cols):
+                _, valid = a.fn(cols)
+                return jnp.zeros((), dtype=np.int32), valid
+
+            fn_lit._literal_value = raw
+            return Lowered(out_type, d, fn_lit)
+        raise NotImplementedError(
+            "cast of a device-resident column to decimal(>18) "
+            "(dictionary-encoded int128 path) — cast to decimal(18,s) or "
+            "double instead")
+    if _is_long_dec(src):
+        if a.dictionary is None:
+            raise NotImplementedError("long-decimal column without dictionary")
+        if np.issubdtype(out_type.storage_dtype, np.floating):
+            return _dict_scalar(a, lambda s: int(s) / (10.0 ** ss), out_type)
+        if is_string(out_type):
+            import decimal as _d
+
+            def fmt(s: str) -> str:
+                with _d.localcontext() as ctx:
+                    ctx.prec = 80
+                    return str(_d.Decimal(int(s)).scaleb(-ss))
+
+            return _dict_transform(a, fmt, VARCHAR)
+        if isinstance(out_type, DecimalType) or np.issubdtype(
+                out_type.storage_dtype, np.integer):
+            shift = ds if isinstance(out_type, DecimalType) else 0
+            return _dict_scalar(
+                a, lambda s: rescale_scaled_int(int(s), ss, shift), out_type)
+        raise NotImplementedError(f"cast decimal(38) -> {out_type}")
 
     def fn(cols: Cols):
         v, vv = a.fn(cols)
@@ -846,6 +1060,13 @@ def _cast_handler(out_type, args):
             data = v.astype(out_type.storage_dtype)
         return data, vv
 
+    # exact-literal propagation: handlers that need a static operand (long-
+    # decimal arithmetic, LIMIT-style ints) see through scalar casts
+    if hasattr(a.fn, "_literal_value") and isinstance(
+            a.fn._literal_value, (int, np.integer)) and (
+            isinstance(out_type, DecimalType)
+            or np.issubdtype(out_type.storage_dtype, np.integer)):
+        fn._literal_value = rescale_scaled_int(int(a.fn._literal_value), ss, ds)
     return Lowered(out_type, None, fn)
 
 
@@ -919,10 +1140,54 @@ def _require_array_dict(col, what: str):
         raise NotImplementedError(f"{what} on non-dictionary array column")
 
 
+def _row_field_handler(out_type, args):
+    """ROW field access (sql/tree/DereferenceExpression): host table of the
+    selected field per row-dictionary entry + device gather."""
+    col = args[0]
+    _require_array_dict(col, "row field access")
+    fi = _literal_int(args[1])
+    vals = [v[fi] if fi < len(v) else None for v in col.dictionary]
+    return _array_table_lookup(col, vals, out_type)
+
+
+def _map_element_at_handler(out_type, args):
+    """element_at(map, key): per-dictionary-entry lookup (entries are
+    key-sorted pair tuples) + device gather."""
+    col, key = args[0], args[1]
+    _require_array_dict(col, "element_at(map)")
+    if hasattr(key.fn, "_literal_value"):
+        needle = key.fn._literal_value
+    elif key.dictionary is not None and len(key.dictionary) == 1:
+        needle = str(key.dictionary[0])
+    else:
+        raise NotImplementedError("map key must be a literal")
+    vals = [dict(v).get(needle) for v in col.dictionary]
+    return _and_extra_valid(
+        _array_table_lookup(col, vals, out_type), args[1:])
+
+
+def _map_parts_handler(which: int):
+    def handler(out_type, args):
+        col = args[0]
+        _require_array_dict(col, "map_keys/map_values")
+        vals = [tuple(p[which] for p in v) for v in col.dictionary]
+        return _array_table_lookup(col, vals, out_type)
+
+    return handler
+
+
 def _cardinality_handler(out_type, args):
     col = args[0]
     _require_array_dict(col, "cardinality")
     return _array_table_lookup(col, [len(v) for v in col.dictionary], BIGINT)
+
+
+def _element_at_dispatch(out_type, args):
+    from ..spi.types import MapType
+
+    if isinstance(args[0].type, MapType):
+        return _map_element_at_handler(out_type, args)
+    return _element_at_handler(out_type, args)
 
 
 def _element_at_handler(out_type, args):
@@ -1040,7 +1305,10 @@ HANDLERS: dict[str, Callable] = {
     "json_extract": _json_extract_handler(scalar=False),
     "json_extract_scalar": _json_extract_handler(scalar=True),
     "json_array_length": _json_array_length_handler,
-    "element_at": _element_at_handler,
+    "element_at": _element_at_dispatch,
+    "$row_field": _row_field_handler,
+    "map_keys": _map_parts_handler(0),
+    "map_values": _map_parts_handler(1),
     "contains": _contains_handler,
     "array_position": _array_position_handler,
     "add": _arith_handler("add"),
@@ -1147,6 +1415,8 @@ def _lower(
         return Lowered(expr.type, input_dicts[idx] if input_dicts else None, fn)
 
     if isinstance(expr, Literal):
+        from ..spi.types import MapType, RowType
+
         t = expr.type
         v = expr.value
         if v is None:
@@ -1154,11 +1424,37 @@ def _lower(
             def fn_null(cols: Cols):
                 return jnp.zeros((), dtype=t.storage_dtype), jnp.zeros((), dtype=bool)
 
-            if isinstance(t, ArrayType):
+            fn_null._literal_null = True
+            if isinstance(t, (ArrayType, RowType, MapType)):
                 d0 = np.empty(1, dtype=object)
                 d0[0] = ()
                 return Lowered(t, d0, fn_null)
+            if _is_long_dec(t):
+                d0 = np.empty(1, dtype=object)
+                d0[0] = 0
+                return Lowered(t, d0, fn_null)
             return Lowered(t, np.array([""], dtype=object) if is_string(t) else None, fn_null)
+        if _is_long_dec(t):
+            from ..spi.batch import _to_scaled_int
+
+            raw = _to_scaled_int(v, t.scale)
+            d = np.empty(1, dtype=object)
+            d[0] = raw
+
+            def fn_ldec(cols: Cols):
+                return jnp.zeros((), dtype=np.int32), None
+
+            fn_ldec._literal_value = raw
+            return Lowered(t, d, fn_ldec)
+        if isinstance(t, (RowType, MapType)):
+            d = np.empty(1, dtype=object)
+            d[0] = (tuple(sorted(v.items())) if isinstance(v, dict)
+                    else tuple(v))
+
+            def fn_rowmap(cols: Cols):
+                return jnp.zeros((), dtype=np.int32), None
+
+            return Lowered(t, d, fn_rowmap)
         if isinstance(t, ArrayType):
             d = np.empty(1, dtype=object)
             d[0] = tuple(v)

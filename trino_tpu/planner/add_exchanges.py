@@ -256,7 +256,20 @@ def _split_aggregate(node: Aggregate, single: bool) -> PlanNode:
     nk = len(node.group_keys)
     has_distinct = any(a.distinct for a in node.aggregates)
 
-    if has_distinct:
+    def _long_dec_avg(a) -> bool:
+        # AVG over decimal(>18): the partial avg state is a scale-free f64
+        # sum, which would lose the wide decimal's exactness across the
+        # exchange — run it SINGLE at the consumer (SUM keeps its exact
+        # limb path through PARTIAL/FINAL: the state is itself a long
+        # decimal)
+        from ..spi.types import DecimalType
+
+        if a.fn != "avg" or a.arg < 0:
+            return False
+        t = node.source.output_types[a.arg]
+        return isinstance(t, DecimalType) and t.precision > 18
+
+    if has_distinct or any(_long_dec_avg(a) for a in node.aggregates):
         # distinct can't pre-aggregate: repartition raw rows on the group
         # keys (or gather when global), aggregate SINGLE at the consumer
         if nk:

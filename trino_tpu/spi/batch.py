@@ -37,6 +37,8 @@ from .types import (
     TIMESTAMP,
     ArrayType,
     DecimalType,
+    MapType,
+    RowType,
     Type,
     days_to_date,
 )
@@ -80,9 +82,21 @@ def _object_array(values) -> np.ndarray:
 def encode_arrays(values: Sequence) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Encode python sequences (arrays) into (codes, valid, dictionary of
     tuples).  Same contract as encode_strings, tuple-valued dictionary."""
-    valid = np.array([v is not None for v in values], dtype=np.bool_)
     filled = [tuple(v) if v is not None else () for v in values]
+    valid = np.array([v is not None for v in values], dtype=np.bool_)
     uniq = sorted(set(filled), key=_canon_key)
+    pos = {v: i for i, v in enumerate(uniq)}
+    codes = np.array([pos[v] for v in filled], dtype=np.int32)
+    return codes, valid, _object_array(uniq)
+
+
+def encode_sorted_objects(values: Sequence, null_fill
+                          ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Encode naturally-orderable python objects (long-decimal scaled ints)
+    into (codes, valid, sorted dictionary)."""
+    valid = np.array([v is not None for v in values], dtype=np.bool_)
+    filled = [v if v is not None else null_fill for v in values]
+    uniq = sorted(set(filled))
     pos = {v: i for i, v in enumerate(uniq)}
     codes = np.array([pos[v] for v in filled], dtype=np.int32)
     return codes, valid, _object_array(uniq)
@@ -130,6 +144,24 @@ class Column:
         if isinstance(type_, ArrayType):
             codes, valid, dictionary = encode_arrays(values)
             return Column(type_, codes, valid, dictionary)
+        if isinstance(type_, DecimalType) and type_.is_long:
+            # long decimal: sorted dictionary of python scaled ints
+            scaled = [None if v is None else _to_scaled_int(v, type_.scale)
+                      for v in values]
+            codes, valid, dictionary = encode_sorted_objects(scaled, 0)
+            return Column(type_, codes, valid, dictionary)
+        if isinstance(type_, RowType):
+            canon = [None if v is None else tuple(v) for v in values]
+            codes, valid, dictionary = encode_arrays(canon)
+            return Column(type_, codes, valid, dictionary)
+        if isinstance(type_, MapType):
+            canon = [
+                None if v is None else tuple(sorted(
+                    v.items() if isinstance(v, dict) else v))
+                for v in values
+            ]
+            codes, valid, dictionary = encode_arrays(canon)
+            return Column(type_, codes, valid, dictionary)
         if type_.is_dictionary_encoded:
             codes, valid, dictionary = encode_strings(values)
             return Column(type_, codes, valid, dictionary)
@@ -168,6 +200,22 @@ class Column:
             d = self.dictionary
             for i in range(len(self)):
                 out.append(list(d[data[i]]) if valid[i] else None)
+        elif isinstance(t, DecimalType) and t.is_long:
+            d = self.dictionary
+            with decimal.localcontext() as ctx:
+                ctx.prec = 80  # default 28-digit context rounds wide values
+                for i in range(len(self)):
+                    out.append(
+                        decimal.Decimal(int(d[data[i]])).scaleb(-t.scale)
+                        if valid[i] else None)
+        elif isinstance(t, RowType):
+            d = self.dictionary
+            for i in range(len(self)):
+                out.append(tuple(d[data[i]]) if valid[i] else None)
+        elif isinstance(t, MapType):
+            d = self.dictionary
+            for i in range(len(self)):
+                out.append(dict(d[data[i]]) if valid[i] else None)
         elif t.is_dictionary_encoded:
             d = self.dictionary
             for i in range(len(self)):
@@ -212,14 +260,31 @@ def _to_micros(v) -> int:
     raise TypeError(f"cannot convert {type(v).__name__} to timestamp")
 
 
+def rescale_scaled_int(v: int, fs: int, ds: int) -> int:
+    """Exact scaled-int rescale with HALF_UP rounding (python bignums,
+    80-digit context — the shared Int128Math-style helper for casts and
+    aggregate finalization)."""
+    if ds >= fs:
+        return v * 10 ** (ds - fs)
+    with decimal.localcontext() as ctx:
+        ctx.prec = 80
+        return int(decimal.Decimal(v).scaleb(ds - fs).quantize(
+            0, rounding=decimal.ROUND_HALF_UP))
+
+
 def _to_scaled_int(v, scale: int) -> int:
     """Exact conversion to scaled int64 (never through float64 for exact
     inputs — int/str/Decimal keep full 18-digit precision)."""
     if isinstance(v, (int, np.integer)):
         return int(v) * 10**scale
     if isinstance(v, (str, decimal.Decimal)):
-        d = decimal.Decimal(v)
-        return int((d * 10**scale).to_integral_value(rounding=decimal.ROUND_HALF_UP))
+        # default decimal context rounds at 28 digits; wide decimals need
+        # the full 38 -> compute under an explicit high-precision context
+        with decimal.localcontext() as ctx:
+            ctx.prec = 80
+            d = decimal.Decimal(v)
+            return int((d * 10**scale).to_integral_value(
+                rounding=decimal.ROUND_HALF_UP))
     return int(round(float(v) * 10**scale))
 
 

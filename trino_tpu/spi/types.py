@@ -42,6 +42,8 @@ __all__ = [
     "TIMESTAMP",
     "DecimalType",
     "ArrayType",
+    "RowType",
+    "MapType",
     "UNKNOWN",
     "parse_type",
     "common_super_type",
@@ -69,7 +71,9 @@ class Type:
 
     @property
     def is_dictionary_encoded(self) -> bool:
-        return self.name in ("varchar", "char") or isinstance(self, ArrayType)
+        return (self.name in ("varchar", "char")
+                or isinstance(self, (ArrayType, RowType, MapType))
+                or (isinstance(self, DecimalType) and self.precision > 18))
 
     def zero_value(self):
         """Neutral fill value for masked-out slots."""
@@ -78,23 +82,79 @@ class Type:
 
 @dataclass(frozen=True)
 class DecimalType(Type):
+    """DECIMAL(p,s).  p<=18 is a scaled int64 ("short decimal" — mirrors
+    io.trino.spi.type.DecimalType's long path).  p>18 (the reference's
+    Int128 path, spi/type/Int128Math.java) is *dictionary-encoded*: the
+    device sees int32 codes into a host-side SORTED dictionary of python
+    scaled ints, so comparisons/ORDER BY/GROUP BY/joins run on codes
+    (order-correct by construction) and exact arithmetic happens via limb
+    decomposition (exec/kernels.decimal_limbs) or host dictionary
+    transforms — the TPU has no native int128 and 64-bit lanes are
+    emulated, so wide-integer vector arithmetic would be a poor fit."""
+
     precision: int = 18
     scale: int = 0
 
     def __init__(self, precision: int = 18, scale: int = 0):
-        if precision > 18:
-            raise NotImplementedError(
-                f"decimal({precision},{scale}): precision > 18 (Int128 path) "
-                "not yet supported"
-            )
+        if precision > 38:
+            raise ValueError(f"decimal({precision},{scale}): max precision 38")
         object.__setattr__(self, "name", f"decimal({precision},{scale})")
-        object.__setattr__(self, "storage_dtype", np.dtype(np.int64))
+        object.__setattr__(
+            self, "storage_dtype",
+            np.dtype(np.int32) if precision > 18 else np.dtype(np.int64))
         object.__setattr__(self, "_coercion_rank", 40)
         object.__setattr__(self, "precision", precision)
         object.__setattr__(self, "scale", scale)
 
+    @property
+    def is_long(self) -> bool:
+        return self.precision > 18
+
     def scale_factor(self) -> int:
         return 10**self.scale
+
+
+@dataclass(frozen=True)
+class RowType(Type):
+    """ROW(name type, ...) (reference: spi/type/RowType.java).  Same
+    dictionary-encoded stance as ARRAY: row *values* are python tuples in a
+    host-side dictionary, the device sees int32 codes; field access is a
+    host table + device gather."""
+
+    fields: tuple = ()  # ((name|None, Type), ...)
+
+    def __init__(self, fields):
+        fields = tuple((n, t) for n, t in fields)
+        inner = ", ".join(
+            (f"{n} {t.name}" if n else t.name) for n, t in fields)
+        object.__setattr__(self, "name", f"row({inner})")
+        object.__setattr__(self, "storage_dtype", np.dtype(np.int32))
+        object.__setattr__(self, "_coercion_rank", -1)
+        object.__setattr__(self, "fields", fields)
+
+    def field_index(self, name: str) -> int:
+        for i, (n, _) in enumerate(self.fields):
+            if n is not None and n.lower() == name.lower():
+                return i
+        raise KeyError(f"row has no field {name!r}")
+
+
+@dataclass(frozen=True)
+class MapType(Type):
+    """MAP(K, V) (reference: spi/type/MapType.java).  Values are host-side
+    dictionaries of canonical tuples of (key, value) pairs sorted by key;
+    the device sees int32 codes (equality/grouping on codes, map functions
+    as host transforms + gathers)."""
+
+    key: "Type" = None
+    value: "Type" = None
+
+    def __init__(self, key: "Type", value: "Type"):
+        object.__setattr__(self, "name", f"map({key.name}, {value.name})")
+        object.__setattr__(self, "storage_dtype", np.dtype(np.int32))
+        object.__setattr__(self, "_coercion_rank", -1)
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
 
 
 @dataclass(frozen=True)
@@ -158,13 +218,17 @@ def common_super_type(a: Type, b: Type) -> Type | None:
         if da and db:
             scale = max(a.scale, b.scale)
             ip = max(a.precision - a.scale, b.precision - b.scale)
-            return DecimalType(min(18, ip + scale), scale)
+            # derived precision only widens into the long (dictionary) path
+            # when an INPUT is already long: short-decimal expressions keep
+            # their proven int64 kernels
+            cap = 38 if (a.precision > 18 or b.precision > 18) else 18
+            return DecimalType(min(cap, ip + scale), scale)
         if da or db:
             dec, other = (a, b) if da else (b, a)
             if other.name in (DOUBLE.name, REAL.name):
                 return DOUBLE
             # integral + decimal -> decimal wide enough for the integral
-            return DecimalType(18, dec.scale)
+            return DecimalType(max(dec.precision, 18), dec.scale)
         ra = a._coercion_rank
         rb = b._coercion_rank
         return a if ra >= rb else b
@@ -175,6 +239,20 @@ def common_super_type(a: Type, b: Type) -> Type | None:
     if isinstance(a, ArrayType) and isinstance(b, ArrayType):
         e = common_super_type(a.element, b.element)
         return ArrayType(e) if e is not None else None
+    if isinstance(a, RowType) and isinstance(b, RowType):
+        if len(a.fields) != len(b.fields):
+            return None
+        fields = []
+        for (an, at), (bn, bt) in zip(a.fields, b.fields):
+            ft = common_super_type(at, bt)
+            if ft is None:
+                return None
+            fields.append((an or bn, ft))
+        return RowType(fields)
+    if isinstance(a, MapType) and isinstance(b, MapType):
+        k = common_super_type(a.key, b.key)
+        v = common_super_type(a.value, b.value)
+        return MapType(k, v) if k is not None and v is not None else None
     return None
 
 
@@ -213,7 +291,42 @@ def parse_type(text: str) -> Type:
         return ArrayType(parse_type(t[len("array("):-1]))
     if t.startswith("array<") and t.endswith(">"):
         return ArrayType(parse_type(t[len("array<"):-1]))
+    if t.startswith("map(") and t.endswith(")"):
+        parts = _split_top(t[len("map("):-1])
+        if len(parts) != 2:
+            raise ValueError(f"map needs two type arguments: {text!r}")
+        return MapType(parse_type(parts[0]), parse_type(parts[1]))
+    if t.startswith("row(") and t.endswith(")"):
+        fields = []
+        for p in _split_top(t[len("row("):-1]):
+            p = p.strip()
+            # "name type" or bare "type"
+            bits = p.split(None, 1)
+            if len(bits) == 2:
+                try:
+                    fields.append((None, parse_type(p)))  # e.g. "decimal(2, 1)"
+                except ValueError:
+                    fields.append((bits[0], parse_type(bits[1])))
+            else:
+                fields.append((None, parse_type(p)))
+        return RowType(fields)
     raise ValueError(f"unknown type: {text!r}")
+
+
+def _split_top(s: str) -> list[str]:
+    """Split on commas at paren depth 0 (type-argument lists)."""
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "(<":
+            depth += 1
+        elif ch in ")>":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(s[start:i])
+            start = i + 1
+    if s[start:].strip():
+        out.append(s[start:])
+    return out
 
 
 _EPOCH = datetime.date(1970, 1, 1)

@@ -102,7 +102,7 @@ _SPECIAL_FUNCTIONS = {
     "sign", "date_trunc", "cardinality", "element_at", "contains",
     "array_position", "approx_distinct", "count_if", "geometric_mean",
     "json_extract", "json_extract_scalar", "json_array_length", "position",
-    "repeat",
+    "repeat", "row", "map", "map_keys", "map_values",
 }
 
 
@@ -209,10 +209,18 @@ def agg_result_type(fn: str, arg_type: Optional[Type]) -> Type:
     if fn == "count":
         return BIGINT
     if fn == "avg" or fn in STAT_AGGS:
+        # Trino: avg(decimal(p,s)) -> decimal(38,s); the long-decimal limb
+        # path keeps it exact.  Short decimals keep the engine's historical
+        # f64 avg (exactness preserved by the scale-free sum state).
+        if (isinstance(arg_type, DecimalType) and arg_type.precision > 18
+                and fn == "avg"):
+            return DecimalType(38, arg_type.scale)
         return DOUBLE
     if fn == "sum":
         if isinstance(arg_type, DecimalType):
-            return DecimalType(18, arg_type.scale)
+            # sum(decimal(p,s)) -> decimal(38,s) when the input is long
+            return DecimalType(38 if arg_type.precision > 18 else 18,
+                               arg_type.scale)
         if arg_type is not None and arg_type.name == "real":
             return arg_type  # sum(real) -> real (Trino semantics)
         if arg_type in (DOUBLE,):
@@ -325,7 +333,27 @@ class Translator:
 
     # -- leaves ------------------------------------------------------------
     def _t_ColumnRef(self, e: ast.ColumnRef) -> RowExpression:
-        level, idx, field = self.scope.resolve(e.parts)
+        from ..spi.types import RowType
+
+        try:
+            level, idx, field = self.scope.resolve(e.parts)
+        except AnalysisError:
+            # row field access: `col.field` parses as a qualified name; if
+            # the prefix resolves to a ROW-typed column, the last part is a
+            # field selector (reference: sql/tree/DereferenceExpression)
+            if len(e.parts) >= 2:
+                try:
+                    level, idx, field = self.scope.resolve(e.parts[:-1])
+                except AnalysisError:
+                    raise AnalysisError(
+                        f"column cannot be resolved: {'.'.join(e.parts)}")
+                if isinstance(field.type, RowType):
+                    base = (InputRef(field.type, idx) if level == 0
+                            else OuterRef(field.type, idx, level))
+                    fi = field.type.field_index(e.parts[-1])
+                    ft = field.type.fields[fi][1]
+                    return Call(ft, "$row_field", (base, Literal(BIGINT, fi)))
+            raise
         if level == 0:
             return InputRef(field.type, idx)
         return OuterRef(field.type, idx, level)
@@ -336,7 +364,17 @@ class Translator:
     def _t_DecimalLiteral(self, e):
         text = e.text.lstrip("-")
         scale = len(text.split(".")[1]) if "." in text else 0
-        return Literal(DecimalType(18, scale), e.text)
+        digits = len(text.replace(".", "").lstrip("0")) or 1
+        # literals type long (the dictionary-encoded int128 path) only when
+        # the scaled value genuinely exceeds int64 — a 19-digit value that
+        # still fits keeps the proven short-decimal kernels, so mixed
+        # literal-vs-short-column expressions behave exactly as before
+        precision = 18
+        if digits > 18:
+            scaled = int(text.replace(".", ""))
+            if scaled > (1 << 63) - 1:
+                precision = min(38, digits)
+        return Literal(DecimalType(precision, scale), e.text)
 
     def _t_DoubleLiteral(self, e):
         return Literal(DOUBLE, e.value)
@@ -402,15 +440,24 @@ class Translator:
         if DOUBLE in (lt, rt) or lt.name == "real" or rt.name == "real":
             return Call(DOUBLE, name, (cast_to(left, DOUBLE), cast_to(right, DOUBLE)))
         if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
-            if name == "divide":
-                return Call(DOUBLE, name, (cast_to(left, DOUBLE), cast_to(right, DOUBLE)))
             ld, rd = _decimal_of(lt), _decimal_of(rt)
+            long_in = ld.precision > 18 or rd.precision > 18
+            if name == "divide":
+                if long_in:
+                    # exact long-decimal division (Trino: decimal / decimal
+                    # stays decimal); the limb/dictionary path keeps it exact
+                    out = DecimalType(38, max(ld.scale, rd.scale))
+                    return Call(out, name, (left, right))
+                return Call(DOUBLE, name, (cast_to(left, DOUBLE), cast_to(right, DOUBLE)))
+            # precision widens only when an INPUT is already long: short
+            # expressions keep the int64 kernels
+            cap = 38 if long_in else 18
             if name in ("add", "subtract"):
-                out = DecimalType(18, max(ld.scale, rd.scale))
+                out = DecimalType(cap, max(ld.scale, rd.scale))
             elif name == "multiply":
-                out = DecimalType(18, ld.scale + rd.scale)
+                out = DecimalType(cap, min(ld.scale + rd.scale, 38))
             else:  # modulus
-                out = DecimalType(18, max(ld.scale, rd.scale))
+                out = DecimalType(cap, max(ld.scale, rd.scale))
             return Call(out, name, (cast_to(left, ld) if not isinstance(lt, DecimalType) else left,
                                     cast_to(right, rd) if not isinstance(rt, DecimalType) else right))
         return Call(BIGINT, name, (cast_to(left, BIGINT), cast_to(right, BIGINT)))
@@ -557,9 +604,23 @@ class Translator:
         return Literal(ArrayType(et), tuple(x.value for x in elems))
 
     def _t_Subscript(self, e: ast.Subscript) -> RowExpression:
+        from ..spi.types import MapType, RowType
+
         base = self.translate(e.base)
+        if isinstance(base.type, MapType):
+            key = self.translate(e.index)
+            return Call(base.type.value, "element_at", (base, key))
+        if isinstance(base.type, RowType):
+            idx = self.translate(e.index)
+            if not isinstance(idx, Literal) or not isinstance(idx.value, int):
+                raise AnalysisError("row subscript must be an integer literal")
+            fi = idx.value - 1  # SQL row fields are 1-based
+            if not (0 <= fi < len(base.type.fields)):
+                raise AnalysisError("row subscript out of range")
+            ft = base.type.fields[fi][1]
+            return Call(ft, "$row_field", (base, Literal(BIGINT, fi)))
         if not isinstance(base.type, ArrayType):
-            raise AnalysisError("subscript requires an array")
+            raise AnalysisError("subscript requires an array, map or row")
         idx = cast_to(self.translate(e.index), BIGINT)
         return Call(base.type.element, "element_at", (base, idx))
 
@@ -722,8 +783,47 @@ class Translator:
             a = self.translate(e.args[0])
             b = self.translate(e.args[1])
             return Call(ArrayType(a.type), "repeat", (a, cast_to(b, BIGINT)))
-        if name in ("cardinality", "element_at", "contains", "array_position"):
+        if name in ("row", "map"):
+            # constant constructors -> dictionary-encoded literals
+            # (reference: sql/tree/Row, MapConstructor; non-constant
+            # construction would need device->dictionary materialization)
+            from ..spi.types import MapType, RowType
+
+            args = [self.translate(x) for x in e.args]
+            if not all(isinstance(x, Literal) for x in args):
+                raise AnalysisError(
+                    f"{name.upper()} constructor arguments must be constants")
+            if name == "row":
+                t = RowType(tuple((None, x.type) for x in args))
+                return Literal(t, tuple(x.value for x in args))
+            if len(args) != 2 or not all(
+                    isinstance(x.type, ArrayType) for x in args):
+                raise AnalysisError("MAP(keys_array, values_array) expected")
+            ks, vs = args[0].value, args[1].value
+            if ks is None or vs is None or len(ks) != len(vs):
+                raise AnalysisError("MAP arrays must be equal length")
+            t = MapType(args[0].type.element, args[1].type.element)
+            return Literal(t, tuple(sorted(zip(ks, vs))))
+        if name in ("map_keys", "map_values"):
+            from ..spi.types import MapType
+
             a = self.translate(e.args[0])
+            if not isinstance(a.type, MapType):
+                raise AnalysisError(f"{name} requires a map argument")
+            et = a.type.key if name == "map_keys" else a.type.value
+            return Call(ArrayType(et), name, (a,))
+        if name in ("cardinality", "element_at", "contains", "array_position"):
+            from ..spi.types import MapType
+
+            a = self.translate(e.args[0])
+            if isinstance(a.type, MapType):
+                if name == "cardinality":
+                    return Call(BIGINT, "cardinality", (a,))
+                if name == "element_at":
+                    b = self.translate(e.args[1])
+                    return Call(a.type.value, "element_at",
+                                (a, cast_to(b, a.type.key)))
+                raise AnalysisError(f"{name} not defined for maps")
             if not isinstance(a.type, ArrayType):
                 raise AnalysisError(f"{name} requires an array argument")
             if name == "cardinality":

@@ -974,16 +974,33 @@ class _Parser:
         return ast.Case(operand, tuple(whens), default)
 
     def parse_type_name(self) -> str:
-        parts = [self.expect_type_word()]
-        if parts[0].lower() in ("double",) and self.cur.kind == "ident" and self.cur.text.lower() == "precision":
+        name = self.expect_type_word()
+        if name.lower() in ("double",) and self.cur.kind == "ident" and self.cur.text.lower() == "precision":
             self.advance()
         if self.accept_op("("):
-            inner = [self.advance().text]
-            while self.accept_op(","):
-                inner.append(self.advance().text)
-            self.expect_op(")")
-            parts[0] += f"({','.join(inner)})"
-        return parts[0]
+            # balanced-paren scan: covers nested/compound type arguments
+            # (row(x bigint, y varchar), map(varchar, array(bigint)), ...)
+            out = ""
+            depth = 1
+            while depth:
+                if self.cur.kind == "eof":
+                    self.fail("unterminated type arguments")
+                t = self.advance().text
+                if t == "(":
+                    depth += 1
+                    out += "("
+                elif t == ")":
+                    depth -= 1
+                    if depth:
+                        out += ")"
+                elif t == ",":
+                    out += ", "
+                else:
+                    if out and not out.endswith("(") and not out.endswith(", "):
+                        out += " "
+                    out += t
+            name += f"({out})"
+        return name
 
     def expect_type_word(self) -> str:
         t = self.cur

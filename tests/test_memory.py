@@ -123,3 +123,58 @@ def test_query_larger_than_pool_completes():
         "select l_orderkey, count(*) from lineitem, orders "
         "where l_orderkey = o_orderkey group by l_orderkey "
         "order by l_orderkey limit 5").rows()
+
+
+def test_partitioned_state_spill_agg():
+    """Q1-style aggregation at a forced tiny disk budget: the operator
+    pre-aggregates to mergeable states, hash-partitions them to spill
+    files, and merges partition-by-partition at finish — results exact,
+    spill_count > 0 (reference: SpillableHashAggregationBuilder.java)."""
+    import trino_tpu.exec.operators as OPS
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.runner import StandaloneQueryRunner
+    from trino_tpu.testing.oracle import assert_same_rows
+
+    spills = []
+    orig = OPS.HashAggregationOperator._spill_states
+
+    def spy(self):
+        orig(self)
+        spills.append(self.spill_count)
+
+    session = Session(default_catalog="tpch", spill_to_disk_bytes=1)
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.05),
+                                   session=session)
+    baseline = StandaloneQueryRunner(default_catalog(scale_factor=0.05))
+    sql = ("select l_returnflag, l_linestatus, sum(l_quantity), "
+           "avg(l_extendedprice), count(*), min(l_discount), "
+           "max(l_shipdate) from lineitem "
+           "group by l_returnflag, l_linestatus order by 1, 2")
+    OPS.HashAggregationOperator._spill_states = spy
+    try:
+        got = runner.execute(sql).rows()
+    finally:
+        OPS.HashAggregationOperator._spill_states = orig
+    assert spills, "agg never spilled despite the 1-byte budget"
+    want = baseline.execute(sql).rows()
+    assert_same_rows(got, want, ordered=True)
+
+
+def test_partitioned_spill_high_cardinality():
+    """High-cardinality grouped sum under spill: groups cross spill events
+    and must merge exactly across partitions."""
+    import trino_tpu.exec.operators as OPS
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.runner import StandaloneQueryRunner
+    from trino_tpu.testing.oracle import assert_same_rows
+
+    session = Session(default_catalog="tpch", spill_to_disk_bytes=1,
+                      splits_per_node=4)
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.02),
+                                   session=session)
+    baseline = StandaloneQueryRunner(default_catalog(scale_factor=0.02))
+    sql = ("select l_orderkey, sum(l_quantity), count(*) from lineitem "
+           "group by l_orderkey")
+    got = runner.execute(sql).rows()
+    want = baseline.execute(sql).rows()
+    assert_same_rows(got, want, ordered=False)

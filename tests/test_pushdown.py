@@ -1,0 +1,63 @@
+"""Connector pushdown negotiation: LIMIT into the scan (reference:
+iterative/rule/PushLimitIntoTableScan.java + ConnectorMetadata.applyLimit).
+The scan stops opening splits once the pushed bound is satisfied; the
+engine Limit re-enforces exactness."""
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.planner.plan import Limit, TableScan
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+def _find(node, kind):
+    if isinstance(node, kind):
+        return node
+    for c in node.children:
+        got = _find(c, kind)
+        if got is not None:
+            return got
+    return None
+
+
+def test_limit_lands_on_scan_and_stops_reads():
+    catalog = default_catalog(scale_factor=0.01)
+    runner = StandaloneQueryRunner(
+        catalog, session=Session(splits_per_node=8))
+    plan = runner.create_plan("select l_orderkey from lineitem limit 3")
+    scan = _find(plan, TableScan)
+    assert scan.limit == 3
+    assert _find(plan, Limit) is not None  # exactness stays with the engine
+
+    conn = catalog.connector("tpch")
+    opened = []
+    orig = type(conn).create_page_source
+
+    def spy(self, split, columns, **kw):
+        opened.append(split)
+        return orig(self, split, columns, **kw)
+
+    type(conn).create_page_source = spy
+    try:
+        rows = runner.execute("select l_orderkey from lineitem limit 3").rows()
+    finally:
+        type(conn).create_page_source = orig
+    assert len(rows) == 3
+    assert len(opened) == 1, f"scan opened {len(opened)} splits for LIMIT 3"
+
+
+def test_limit_not_pushed_through_filter():
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+    plan = runner.create_plan(
+        "select l_orderkey from lineitem where l_quantity > 10 limit 3")
+    scan = _find(plan, TableScan)
+    assert scan.limit is None  # a filter between limit and scan blocks it
+    rows = runner.execute(
+        "select l_orderkey from lineitem where l_quantity > 10 limit 3").rows()
+    assert len(rows) == 3
+
+
+def test_planning_is_side_effect_free():
+    """EXPLAIN/plan must not leak the pushed bound anywhere stateful: the
+    same runner returns full results after planning a LIMIT query."""
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+    runner.create_plan("select n_name from nation limit 2")
+    assert runner.execute("select count(*) from nation").rows() == [(25,)]

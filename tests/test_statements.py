@@ -1,0 +1,97 @@
+"""Statement breadth: views, materialized views, SET SESSION, CALL
+procedures, ANALYZE (round-4 VERDICT missing item #9; reference:
+execution/CreateViewTask.java, CreateMaterializedViewTask.java,
+SetSessionTask.java, spi/procedure/Procedure.java,
+StatisticsWriterOperator.java:35)."""
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session, StandaloneQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return StandaloneQueryRunner(default_catalog(scale_factor=0.01),
+                                 session=Session(default_catalog="tpch"))
+
+
+def test_create_view_and_query(runner):
+    runner.execute("create view big_nations as "
+                   "select n_name, n_regionkey from nation where n_nationkey > 20")
+    rows = runner.execute("select count(*) from big_nations").rows()
+    assert rows == [(4,)]
+    rows = runner.execute(
+        "select v.n_name from big_nations v join region r "
+        "on v.n_regionkey = r.r_regionkey where r.r_name = 'ASIA' "
+        "order by 1").rows()
+    assert all(isinstance(r[0], str) for r in rows)
+    # view shows up in SHOW TABLES
+    tables = [r[0] for r in runner.execute("show tables").rows()]
+    assert "big_nations" in tables
+    with pytest.raises(ValueError):
+        runner.execute("create view big_nations as select 1")
+    runner.execute("create or replace view big_nations as "
+                   "select n_name from nation")
+    assert runner.execute("select count(*) from big_nations").rows() == [(25,)]
+    runner.execute("drop view big_nations")
+    with pytest.raises(Exception):
+        runner.execute("select * from big_nations")
+    runner.execute("drop view if exists big_nations")  # idempotent
+
+
+def test_materialized_view_refresh(runner):
+    runner.execute("create table memory.mv_src (x bigint)")
+    runner.execute("insert into memory.mv_src values (1), (2)")
+    runner.execute("create materialized view mv_sum as "
+                   "select sum(x) as s from memory.mv_src")
+    assert runner.execute("select s from mv_sum").rows() == [(3,)]
+    # stale until refreshed (the materialized read hits the backing table)
+    runner.execute("insert into memory.mv_src values (10)")
+    assert runner.execute("select s from mv_sum").rows() == [(3,)]
+    runner.execute("refresh materialized view mv_sum")
+    assert runner.execute("select s from mv_sum").rows() == [(13,)]
+    runner.execute("drop materialized view mv_sum")
+
+
+def test_set_session(runner):
+    out = runner.execute("set session dynamic_filtering = false").rows()
+    assert runner.session.dynamic_filtering is False
+    assert "false" in str(out[0][0]).lower()
+    runner.execute("set session splits_per_node = 2")
+    assert runner.session.splits_per_node == 2
+    with pytest.raises(KeyError):
+        runner.execute("set session no_such_knob = 1")
+
+
+def test_call_procedure(runner):
+    runner.execute("create table memory.pt (x bigint)")
+    runner.execute("insert into memory.pt values (1), (2), (3)")
+    out = runner.execute("call memory.truncate_table('pt')").rows()
+    assert "truncated" in out[0][0]
+    assert runner.execute("select count(*) from memory.pt").rows() == [(0,)]
+    with pytest.raises(KeyError):
+        runner.execute("call memory.no_such_proc()")
+
+
+def test_analyze_feeds_stats(runner):
+    runner.execute("create table memory.an (k bigint, s varchar)")
+    runner.execute("insert into memory.an values (1, 'a'), (2, 'b'), "
+                   "(2, 'b'), (3, null)")
+    rows = runner.execute("analyze memory.an").rows()
+    assert rows == [(4,)]
+    stats = runner.catalog.connector("memory").get_table_statistics("an")
+    assert stats.row_count == 4.0
+    assert stats.ndv["k"] == 3.0
+    assert stats.ndv["s"] == 2.0
+
+
+def test_views_and_session_distributed():
+    dist = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2))
+    dist.execute("create view rv as select r_name from region")
+    assert dist.execute("select count(*) from rv").rows() == [(5,)]
+    dist.execute("set session use_collectives = false")
+    assert dist.session.use_collectives is False

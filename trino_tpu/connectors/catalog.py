@@ -14,6 +14,19 @@ from ..spi.connector import Connector, TableSchema
 __all__ = ["Catalog", "default_catalog"]
 
 
+class ViewDefinition:
+    """A stored view: the defining query AST, plus (for materialized views)
+    the backing table holding the last refresh (reference:
+    spi/connector/ConnectorViewDefinition + MaterializedViewDefinition)."""
+
+    __slots__ = ("query", "materialized", "backing")
+
+    def __init__(self, query, materialized: bool = False, backing=None):
+        self.query = query
+        self.materialized = materialized
+        self.backing = backing  # (catalog, table) of the refresh target
+
+
 class Catalog:
     def __init__(self):
         self._connectors: dict[str, Connector] = {}
@@ -24,6 +37,9 @@ class Catalog:
         from ..spi.table_function import builtin_table_functions
 
         self.table_functions: dict = builtin_table_functions()
+        # view registry: name -> ViewDefinition (reference:
+        # metadata/MetadataManager view/materialized-view maps)
+        self.views: dict = {}
 
     def register(self, name: str, connector: Connector) -> None:
         self._connectors[name] = connector

@@ -149,6 +149,9 @@ class FileConnector(Connector):
             for c in meta["columns"]))
 
     def get_table_statistics(self, table: str) -> TableStatistics:
+        analyzed = getattr(self, "_analyzed_stats", {}).get(table)
+        if analyzed is not None:
+            return analyzed
         try:
             with open(self._meta_path(table)) as f:
                 meta = json.load(f)

@@ -129,12 +129,34 @@ class MemoryConnector(Connector):
             return self._schemas[table]
 
     def get_table_statistics(self, table: str) -> TableStatistics:
+        analyzed = getattr(self, "_analyzed_stats", {}).get(table)
+        if analyzed is not None:
+            return analyzed
         with self._lock:
             if table in self._pinned_rows:
                 rows = self._pinned_rows[table]
             else:
                 rows = sum(b.num_rows for b in self._data.get(table, []))
         return TableStatistics(row_count=float(rows))
+
+    def get_procedures(self) -> dict:
+        """CALL memory.truncate_table('t') / memory.pin_table('t')
+        (reference: spi/procedure/Procedure.java — connector-registered
+        procedures dispatched by CallTask)."""
+
+        def truncate_table(table: str) -> str:
+            with self._lock:
+                if table not in self._schemas:
+                    raise KeyError(f"memory: no such table {table!r}")
+                self._data[table] = []
+                self._pinned_rows.pop(table, None)
+            return f"truncated {table}"
+
+        def pin_table(table: str) -> str:
+            self.pin_to_device(table)
+            return f"pinned {table}"
+
+        return {"truncate_table": truncate_table, "pin_table": pin_table}
 
     def create_table(self, schema: TableSchema) -> None:
         with self._lock:

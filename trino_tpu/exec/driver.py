@@ -109,10 +109,51 @@ class Driver:
 
 def run_pipelines(pipelines: Sequence[Sequence[Operator]],
                   stats: Optional[QueryStats] = None) -> None:
-    """Execute pipelines in dependency order (build sides first)."""
-    for p in pipelines:
+    """Execute pipelines in dependency order (build sides first).
+    Consecutive sibling chains feeding the SAME LocalUnionBridge (the
+    intra-task local exchange — task_concurrency source drivers) run on
+    concurrent threads; numpy/XLA release the GIL inside kernels, so the
+    shards genuinely overlap."""
+    import threading
+
+    from .operators import UnionSinkOperator
+
+    def run_one(p) -> None:
         ps = None
         if stats is not None:
             ps = PipelineStats()
             stats.pipelines.append(ps)
         Driver(p, ps).run()
+
+    i = 0
+    n = len(pipelines)
+    while i < n:
+        p = pipelines[i]
+        group = [p]
+        if isinstance(p[-1], UnionSinkOperator) and p[-1].bridge.concurrent:
+            bridge = p[-1].bridge
+            while (i + 1 < n
+                   and isinstance(pipelines[i + 1][-1], UnionSinkOperator)
+                   and pipelines[i + 1][-1].bridge is bridge):
+                i += 1
+                group.append(pipelines[i])
+        if len(group) > 1:
+            errors: list[BaseException] = []
+
+            def wrapped(q):
+                try:
+                    run_one(q)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=wrapped, args=(q,),
+                                        daemon=True) for q in group]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        else:
+            run_one(p)
+        i += 1

@@ -71,7 +71,9 @@ class LocalPlanner:
                  task_count: int = 1, remote_clients=None,
                  dynamic_filtering: bool = True,
                  hbm_limit_bytes: int = 16 << 30,
-                 spill_to_disk_bytes: int = 0):
+                 spill_to_disk_bytes: int = 0,
+                 task_concurrency: int = 1):
+        self.task_concurrency = task_concurrency
         self.catalog = catalog
         self.splits_per_node = splits_per_node
         self.node_count = node_count
@@ -91,12 +93,57 @@ class LocalPlanner:
         collector = OutputCollector()
         chain.append(collector)
         self.pipelines.append(chain)
+        if self.task_concurrency > 1:
+            self.pipelines = [
+                q for p in self.pipelines for q in self._parallelize(p)]
         for p in self.pipelines:
             for op in p:
                 if isinstance(op, BufferedInputMixin):
                     op.attach_memory(self.memory)
         return LocalExecutionPlan(
             self.pipelines, collector, root.output_names, root.output_types)
+
+    def _parallelize(self, pipeline: list[Operator]) -> list[list[Operator]]:
+        """Intra-task parallelism (LocalExchange.java:67 gather mode +
+        AddLocalExchanges.java:111): a pipeline whose source is a multi-
+        split scan forks into ``task_concurrency`` concurrent source driver
+        chains (scan shard + cloned filter/project programs), merged through
+        a LocalUnionBridge into the original downstream chain.  The driver
+        runner executes sibling chains on concurrent threads."""
+        if not isinstance(pipeline[0], ScanOperator):
+            return [pipeline]
+        scan = pipeline[0]
+        c = min(self.task_concurrency, len(scan.splits))
+        if c < 2:
+            return [pipeline]
+        prefix = [scan]
+        for op in pipeline[1:]:
+            if isinstance(op, FilterProjectOperator):
+                prefix.append(op)
+            else:
+                break
+        rest = pipeline[len(prefix):]
+        if not rest:  # nothing downstream to feed (shouldn't happen)
+            return [pipeline]
+        last = prefix[-1]
+        names = (last.output_names if isinstance(last, FilterProjectOperator)
+                 else scan.columns)
+        bridge = LocalUnionBridge(c)
+        bridge.concurrent = True
+        chains: list[list[Operator]] = []
+        for i in range(c):
+            shard = ScanOperator(
+                scan.connector, scan.splits[i::c], scan.columns,
+                dynamic_filters=scan.dynamic_filters,
+                constraint=scan.constraint, limit=scan.limit)
+            fps: list[Operator] = [
+                FilterProjectOperator(f.predicate, f.projections,
+                                      f.output_names, f.output_types)
+                for f in prefix[1:]
+            ]
+            chains.append([shard] + fps + [UnionSinkOperator(bridge, names)])
+        consumer: list[Operator] = [UnionSourceOperator(bridge)] + rest
+        return chains + [consumer]
 
     # ------------------------------------------------------------------
     def _chain(self, node: P.PlanNode) -> list[Operator]:
@@ -107,7 +154,8 @@ class LocalPlanner:
             mine = [s for i, s in enumerate(splits)
                     if i % self.task_count == self.task_index]
             return [ScanOperator(conn, mine, node.columns,
-                                 constraint=node.constraint)]
+                                 constraint=node.constraint,
+                                 limit=node.limit)]
 
         if isinstance(node, P.RemoteSource):
             from ..execution.collective_exchange import (

@@ -104,11 +104,16 @@ class ScanOperator(Operator):
 
     def __init__(self, connector: Connector, splits: Sequence[Split],
                  columns: Sequence[str], dynamic_filters=None,
-                 constraint=None):
+                 constraint=None, limit: Optional[int] = None):
         self.connector = connector
         self.splits = list(splits)
         self.columns = list(columns)
         self.dynamic_filters = list(dynamic_filters or [])
+        # pushed-down LIMIT: stop opening further splits once this many
+        # rows are out (only exact for unmasked batches; the engine Limit
+        # above re-enforces the precise count)
+        self.limit = limit
+        self._emitted_rows = 0
         # advisory TupleDomain from predicate pushdown (exec/domain_filter.py)
         self.constraint = constraint if (
             constraint is not None and not constraint.is_all) else None
@@ -150,6 +155,10 @@ class ScanOperator(Operator):
             if self._closed:
                 return None
             if self._source is None:
+                if (self.limit is not None
+                        and self._emitted_rows >= self.limit):
+                    # pushed-down LIMIT satisfied: drop remaining splits
+                    self.splits = []
                 if not self.splits:
                     return None
                 # kwarg only when constrained: wrapper connectors with the
@@ -180,6 +189,8 @@ class ScanOperator(Operator):
                         continue
                 # bucket scan output shapes so every downstream jitted
                 # program compiles once per (pipeline, bucket)
+                if self.limit is not None and batch.live is None:
+                    self._emitted_rows += batch.num_rows
                 return pad_to_bucket(batch)
 
     def is_finished(self) -> bool:
@@ -245,6 +256,15 @@ class LocalUnionBridge:
         self.num_inputs = num_inputs
         self.batches: "deque[ColumnBatch]" = deque()
         self.finished_inputs = 0
+        self._lock = threading.Lock()  # sinks may run on concurrent drivers
+        # True only for task_concurrency source forks: the driver runner
+        # threads sibling chains for these (plain UNION branches may hold
+        # memory-accounted operators that assume one thread)
+        self.concurrent = False
+
+    def input_finished(self) -> None:
+        with self._lock:
+            self.finished_inputs += 1
 
     @property
     def all_finished(self) -> bool:
@@ -262,7 +282,7 @@ class UnionSinkOperator(Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
-        self.bridge.finished_inputs += 1
+        self.bridge.input_finished()
 
     def is_finished(self) -> bool:
         return self.input_done
@@ -584,6 +604,7 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
     (operator/aggregation/builder/InMemoryHashAggregationBuilder.java)."""
 
     FLUSH_ROWS = 1 << 20
+    SPILL_PARTITIONS = 16
 
     def __init__(self, group_keys: Sequence[int], aggs: Sequence[AggCall],
                  output_names: Sequence[str], output_types: Sequence[Type],
@@ -598,6 +619,129 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         self._flushed: list[ColumnBatch] = []
         self._result: Optional[ColumnBatch] = None
         self._emitted = False
+        # partitioned state spill (SpillableHashAggregationBuilder.java):
+        # one spill file per hash partition of pre-aggregated states
+        self._state_spillers: Optional[list] = None
+        self._spill_layout = None  # (p_names, p_types, final_calls)
+
+    # -- partitioned state spill -------------------------------------------
+    def _spill_eligible(self) -> bool:
+        return (self.step in ("SINGLE", "FINAL")
+                and not any(a.distinct for a in self.aggs))
+
+    def _maybe_spill_to_disk(self) -> None:
+        """Disk tier override: instead of dumping RAW input pages, pre-
+        aggregate the buffer into mergeable partial states, hash-partition
+        them by group key, and append each partition to its own spill file
+        (reference: operator/aggregation/builder/
+        SpillableHashAggregationBuilder.java — spill states, merge on
+        unspill, memory bounded by the largest partition)."""
+        limit = getattr(self._mem, "spill_to_disk_bytes", 0) if self._mem else 0
+        if not limit or not self._batches:
+            return
+        if not self._spill_eligible():
+            super()._maybe_spill_to_disk()  # raw-page fallback (distinct)
+            return
+        host_bytes = sum(
+            b.nbytes for b in self._batches
+            if b.columns and isinstance(b.columns[0].data, np.ndarray))
+        device_rows = sum(
+            b.num_rows for b in self._batches
+            if b.columns and not isinstance(b.columns[0].data, np.ndarray))
+        if host_bytes <= limit and device_rows * 8 <= limit:
+            return
+        self._spill_states()
+
+    def _ensure_spill_layout(self):
+        if self._spill_layout is not None:
+            return self._spill_layout
+        from ..planner.add_exchanges import partial_agg_layout
+
+        nk = len(self.group_keys)
+        if self.step == "FINAL":
+            # input IS already a state layout: spill rows pass through and
+            # merge with the operator's own call list
+            self._spill_layout = (None, None, list(self.aggs))
+            return self._spill_layout
+        layouts = partial_agg_layout(self.aggs, None)
+        p_names = [f"k{i}" for i in range(nk)]
+        p_types: list = [None] * nk  # filled from input at first spill
+        f_calls = []
+        ch = nk
+        for a, states in zip(self.aggs, layouts):
+            f_calls.append(AggCall(a.fn, ch, a.type, False))
+            for j, (fn, t) in enumerate(states):
+                p_names.append(f"s{ch}_{j}")
+                p_types.append(t)
+            ch += len(states)
+        self._spill_layout = (p_names, p_types, f_calls)
+        return self._spill_layout
+
+    def _partial_state_batch(self) -> ColumnBatch:
+        """Pre-aggregate the current buffer into mergeable partial states
+        (or pass state rows through under FINAL)."""
+        if self.step == "FINAL":
+            return ColumnBatch.concat(self._batches)
+        p_names, p_types, _ = self._ensure_spill_layout()
+        tmp = HashAggregationOperator(
+            self.group_keys, self.aggs, p_names,
+            self._partial_types(), "PARTIAL")
+        tmp._batches = self._batches
+        return tmp._compute().compact()
+
+    def _partial_types(self) -> list:
+        """Concrete partial-state types (keys from the buffered input)."""
+        p_names, p_types, _ = self._ensure_spill_layout()
+        inp = self._batches[0]
+        nk = len(self.group_keys)
+        key_types = [inp.columns[c].type for c in self.group_keys]
+        return key_types + [t for t in p_types[nk:]]
+
+    def _spill_states(self) -> None:
+        from .spill import Spiller
+        from ..execution.task import _partition_key_tuple
+
+        state = self._partial_state_batch()
+        if self._state_spillers is None:
+            self._state_spillers = [Spiller()
+                                    for _ in range(self.SPILL_PARTITIONS)]
+        nk = len(self.group_keys)
+        if nk:
+            keys = [_partition_key_tuple(state.columns[c])
+                    for c in range(nk)]
+            parts = K.partition_assignments(keys, self.SPILL_PARTITIONS)
+        else:
+            parts = np.zeros(state.num_rows, np.int32)
+        for p in range(self.SPILL_PARTITIONS):
+            sub = state.filter(parts == p)
+            if sub.num_rows:
+                self._state_spillers[p].spill(sub)
+        self._batches = []
+        self._buffered_rows = 0
+        self.spill_count = getattr(self, "spill_count", 0) + 1
+        if self._mem is not None:
+            self._mem.update(self, 0)
+
+    def _merge_spilled(self) -> list[ColumnBatch]:
+        """Per-partition merge of spilled states (merge-on-unspill): memory
+        is bounded by one partition's states at a time."""
+        _, _, f_calls = self._ensure_spill_layout()
+        nk = len(self.group_keys)
+        outs: list[ColumnBatch] = []
+        for sp in self._state_spillers:
+            batches = list(sp.read_back())
+            sp.close()
+            if not batches:
+                continue
+            merger = HashAggregationOperator(
+                list(range(nk)), f_calls, self.output_names,
+                self.output_types, "FINAL")
+            merger._batches = batches
+            out = merger._compute()
+            if out.num_rows:
+                outs.append(out)
+        self._state_spillers = None
+        return outs
 
     def _can_flush(self) -> bool:
         # PARTIAL states merge downstream; SINGLE/FINAL must see all input.
@@ -642,6 +786,16 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
 
     def finish_input(self) -> None:
         super().finish_input()
+        if self._state_spillers is not None:
+            # flush the tail, then merge partition-by-partition (memory
+            # bounded by the largest partition, not the whole input)
+            if self._batches:
+                self._spill_states()
+            self._flushed.extend(self._merge_spilled())
+            self._result = None
+            self._emitted = True
+            self.release_memory()
+            return
         if self._flushed and not self._batches:
             self._result = None  # everything already emitted via flushes
             self._emitted = True

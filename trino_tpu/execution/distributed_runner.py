@@ -127,6 +127,11 @@ class DistributedQueryRunner:
             return txn
         check_ddl_access(stmt, self.access_control, self.session.user,
                          self.session.default_catalog)
+        from ..runner import execute_session_stmt
+
+        sess = execute_session_stmt(stmt, self.session)
+        if sess is not None:
+            return sess
         if isinstance(stmt, ast.Explain):
             subplan = fragment_plan(self._plan_stmt(stmt.statement))
             lines = subplan.text().splitlines()
@@ -392,6 +397,7 @@ class DistributedQueryRunner:
             remote_clients=clients,
             dynamic_filtering=self.session.dynamic_filtering,
             hbm_limit_bytes=self.session.hbm_limit_bytes,
+            task_concurrency=self.session.task_concurrency,
         )
         local = planner.plan(f.root)
         # swap the collector for the task's output sink

@@ -134,6 +134,7 @@ class LogicalPlanner:
     def __init__(self, catalog: Catalog, default_catalog: str = "tpch"):
         self.catalog = catalog
         self.default_catalog = default_catalog
+        self._view_stack: set[str] = set()  # cycle detection for view inlining
 
     # ------------------------------------------------------------------ api
     def plan(self, stmt: ast.Statement) -> PlanNode:
@@ -882,6 +883,33 @@ class LogicalPlanner:
             if r.name in ctes:
                 rel = self.plan_query(ctes[r.name], None, ctes)
                 qual = r.alias or r.name
+                return RelationPlan(rel.node, [qual] * rel.width)
+            # views resolve by UNQUALIFIED name only: a qualified reference
+            # (catalog.table) always names the real table, so a view can
+            # never shadow another catalog's table
+            vname = r.name if "." not in r.name else None
+            view = self.catalog.views.get(vname) if vname else None
+            if view is not None:
+                if vname in self._view_stack:
+                    raise AnalysisError(
+                        f"view is recursive: {vname}")
+                qual = r.alias or vname
+                if view.materialized and view.backing is not None:
+                    # read the last refresh's backing table
+                    bcat, btable = view.backing
+                    schema = self.catalog.connector(bcat).get_table_schema(
+                        btable)
+                    cols = tuple(c.name for c in schema.columns)
+                    types = tuple(c.type for c in schema.columns)
+                    node = TableScan(cols, types, bcat, btable, cols)
+                    return RelationPlan(node, [qual] * len(cols))
+                # plain view: inline the defining query (the reference
+                # expands views during analysis — StatementAnalyzer views)
+                self._view_stack.add(vname)
+                try:
+                    rel = self.plan_query(view.query, None, {})
+                finally:
+                    self._view_stack.discard(vname)
                 return RelationPlan(rel.node, [qual] * rel.width)
             cat, table, schema = self.catalog.resolve_table(r.name, self.default_catalog)
             cols = tuple(c.name for c in schema.columns)

@@ -58,7 +58,56 @@ def optimize(root: PlanNode, catalog: Catalog) -> PlanNode:
     assert mapping == list(range(len(node.output_types))), "root remap escaped"
     node = _prune(node, set(range(len(node.output_types))))[0]
     node = _attach_scan_constraints(node)
+    node = _push_limit_into_scan(node, catalog)
     return node
+
+
+def _push_limit_into_scan(node: PlanNode, catalog: Catalog) -> PlanNode:
+    """LIMIT over a (projected) scan lets the scan stop opening further
+    splits once the bound is satisfied (reference: iterative/rule/
+    PushLimitIntoTableScan.java; the engine Limit stays for exactness).
+    Planning is side-effect free: the bound travels on the TableScan node,
+    never as connector state."""
+    from dataclasses import replace as _replace
+
+    def pushable_scan(n: PlanNode) -> Optional[TableScan]:
+        # only row-preserving hops between Limit and scan
+        if isinstance(n, TableScan):
+            return n if n.constraint is None else None
+        if isinstance(n, Project):
+            return pushable_scan(n.source)
+        return None
+
+    def walk(n: PlanNode) -> PlanNode:
+        kids = tuple(walk(c) for c in n.children)
+        if kids != tuple(n.children):
+            n = _replace_children(n, kids)
+        if isinstance(n, Limit):
+            scan = pushable_scan(n.source)
+            if scan is not None:
+                cap = (min(scan.limit, n.count) if scan.limit is not None
+                       else n.count)
+
+                def set_limit(m: PlanNode) -> PlanNode:
+                    if isinstance(m, TableScan):
+                        return _replace(m, limit=cap)
+                    return _replace_children(
+                        m, tuple(set_limit(c) for c in m.children))
+
+                n = _replace(n, source=set_limit(n.source))
+        return n
+
+    def _replace_children(n: PlanNode, kids) -> PlanNode:
+        names = [f.name for f in n.__dataclass_fields__.values()]
+        if "source" in names and len(kids) == 1:
+            return _replace(n, source=kids[0])
+        if "left" in names and len(kids) == 2:
+            return _replace(n, left=kids[0], right=kids[1])
+        if "sources" in names:
+            return _replace(n, sources=tuple(kids))
+        return n
+
+    return walk(node)
 
 
 def _attach_scan_constraints(node: PlanNode) -> PlanNode:
@@ -659,6 +708,12 @@ def _prune(node: PlanNode, needed: set[int]) -> tuple[PlanNode, list[Optional[in
 
     if isinstance(node, Project):
         kept = sorted(needed)
+        if not kept and node.expressions:
+            # a zero-column batch cannot carry its row count (the padded
+            # live-mask model needs at least one array): keep the cheapest
+            # channel for count(*)-style consumers (the reference's pruning
+            # keeps a smallest column for the same reason)
+            kept = [0]
         child_needed = set()
         for i in kept:
             child_needed |= _refs(node.expressions[i])

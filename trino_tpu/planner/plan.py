@@ -49,6 +49,10 @@ class TableScan(PlanNode):
     # reference: PushPredicateIntoTableScan with enforced=false) — excluded
     # from eq/hash (it is derived state, and TupleDomain holds a dict)
     constraint: Optional[object] = field(default=None, compare=False)
+    # LIMIT pushed into the scan (reference: PushLimitIntoTableScan +
+    # ConnectorMetadata.applyLimit): the scan may stop reading splits after
+    # this many rows; the engine Limit above re-enforces exactly
+    limit: Optional[int] = None
 
     def label(self) -> str:
         c = ""

@@ -40,6 +40,72 @@ def count_result(name: str, n: int) -> "QueryResult":
         [name], [Column(BIGINT, np.array([n], np.int64))]))
 
 
+def _refresh_materialized_view(name: str, catalog, run_select) -> int:
+    """(Re)materialize a view into its backing table in the 'memory'
+    catalog; returns the row count (reference:
+    operator/RefreshMaterializedViewOperator.java:27)."""
+    from .connectors.catalog import ViewDefinition  # noqa: F401
+    from .spi.connector import ColumnSchema, TableSchema
+
+    view = catalog.views[name]
+    conn = catalog.connector("memory")
+    result = run_select(ast.QueryStatement(view.query))
+    batch = result.batch.compact()
+    backing = f"__mv_{name}"
+    conn.drop_table(backing)
+    conn.create_table(TableSchema(backing, tuple(
+        ColumnSchema(n, c.type)
+        for n, c in zip(result.names, batch.columns))))
+    sink = conn.create_page_sink(backing)
+    sink.append(batch.rename(list(result.names)))
+    conn.finish_insert(backing, sink.finish())
+    view.backing = ("memory", backing)
+    return batch.num_rows
+
+
+def _literal_value(e):
+    """Constant AST node -> python value (SET SESSION / CALL arguments)."""
+    if isinstance(e, (ast.IntLiteral, ast.DoubleLiteral, ast.BooleanLiteral,
+                      ast.StringLiteral)):
+        return e.value
+    if isinstance(e, ast.DecimalLiteral):
+        return float(e.text)
+    if isinstance(e, ast.NullLiteral):
+        return None
+    raise ValueError("expected a constant")
+
+
+# knobs SET SESSION may touch; identity/transaction/injection state is NOT
+# settable through SQL (a restricted user must not setattr session.user)
+SETTABLE_SESSION_PROPERTIES = {
+    "default_catalog", "splits_per_node", "node_count", "dynamic_filtering",
+    "hbm_limit_bytes", "spill_to_disk_bytes", "use_collectives",
+    "exchange_serde", "retry_policy", "task_retry_attempts",
+    "task_scheduler", "executor_workers", "query_concurrency",
+    "query_max_queued", "scale_writers", "writer_task_limit",
+    "task_concurrency",
+}
+
+
+def execute_session_stmt(stmt, session) -> Optional["QueryResult"]:
+    """SET SESSION (reference: execution/SetSessionTask.java): mutate a
+    public Session knob with loose literal typing."""
+    if not isinstance(stmt, ast.SetSession):
+        return None
+    name = stmt.name.lower()
+    if name not in SETTABLE_SESSION_PROPERTIES:
+        raise KeyError(f"unknown or protected session property: {name}")
+    value = _literal_value(stmt.value)
+    current = getattr(session, name)
+    if isinstance(current, bool) and not isinstance(value, bool):
+        value = str(value).lower() in ("true", "1")
+    elif isinstance(current, int) and not isinstance(value, bool) \
+            and value is not None:
+        value = int(value)
+    setattr(session, name, value)
+    return text_result("result", [f"{name} = {value}"])
+
+
 def execute_ddl(stmt, catalog, default_catalog_name: str,
                 run_select) -> Optional["QueryResult"]:
     """Metadata statements shared by both runners (CREATE TABLE with
@@ -62,6 +128,69 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
         if catalog.sql_functions.pop(stmt.name.lower(), None) is None:
             raise KeyError(f"no such function: {stmt.name}")
         return count_result("rows", 0)
+    if isinstance(stmt, ast.CreateView):
+        from .connectors.catalog import ViewDefinition
+
+        name = stmt.name.split(".")[-1]
+        if name in catalog.views and not stmt.replace:
+            raise ValueError(f"view already exists: {name}")
+        catalog.views[name] = ViewDefinition(stmt.query, stmt.materialized)
+        if stmt.materialized:
+            _refresh_materialized_view(name, catalog, run_select)
+        return count_result("rows", 0)
+    if isinstance(stmt, ast.DropView):
+        name = stmt.name.split(".")[-1]
+        view = catalog.views.pop(name, None)
+        if view is None:
+            if stmt.if_exists:
+                return count_result("rows", 0)
+            raise KeyError(f"no such view: {name}")
+        if view.backing is not None:
+            catalog.connector(view.backing[0]).drop_table(view.backing[1])
+        return count_result("rows", 0)
+    if isinstance(stmt, ast.RefreshMaterializedView):
+        name = stmt.name.split(".")[-1]
+        if name not in catalog.views or not catalog.views[name].materialized:
+            raise KeyError(f"no such materialized view: {name}")
+        rows = _refresh_materialized_view(name, catalog, run_select)
+        return count_result("rows", rows)
+    if isinstance(stmt, ast.CallProcedure):
+        cat, proc = _split_name(stmt.name, default_catalog_name)
+        procs = catalog.connector(cat).get_procedures()
+        if proc not in procs:
+            raise KeyError(f"no such procedure: {cat}.{proc}")
+        out = procs[proc](*[_literal_value(a) for a in stmt.args])
+        return text_result("result", [str(out)])
+    if isinstance(stmt, ast.Analyze):
+        cat, table, schema = catalog.resolve_table(
+            stmt.table, default_catalog_name)
+        conn = catalog.connector(cat)
+        from .spi.connector import TableStatistics
+
+        rows = 0
+        ndv: dict[str, set] = {c.name: set() for c in schema.columns}
+        cols = [c.name for c in schema.columns]
+        for split in conn.get_splits(table, 1, 1):
+            src = conn.create_page_source(split, cols)
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is None:
+                    continue
+                b = b.compact()
+                rows += b.num_rows
+                for name_, col in zip(b.names, b.columns):
+                    data = np.asarray(col.data)
+                    if col.valid is not None:
+                        data = data[np.asarray(col.valid)]
+                    if col.dictionary is not None:
+                        # codes are per-batch namespaces: count VALUES
+                        ndv[name_].update(col.dictionary[np.unique(data)])
+                    else:
+                        ndv[name_].update(np.unique(data).tolist())
+        conn.set_analyzed_statistics(table, TableStatistics(
+            row_count=float(rows),
+            ndv={k: float(len(v)) for k, v in ndv.items()}))
+        return count_result("rows", rows)
     if isinstance(stmt, ast.CreateTable):
         cat, table = _split_name(stmt.table, default_catalog_name)
         conn = catalog.connector(cat)
@@ -233,6 +362,10 @@ class Session:
     # (stage-by-stage spooled exchange + per-task retry)
     retry_policy: str = "NONE"
     task_retry_attempts: int = 2
+    # intra-task parallelism: concurrent source drivers per pipeline over a
+    # local gather exchange (reference: LocalExchange.java:67 +
+    # AddLocalExchanges.java:111; task_concurrency session property)
+    task_concurrency: int = 1
     # THREADS = a thread per task; TIME_SHARING = bounded worker pool with
     # MLFQ quanta (TimeSharingTaskExecutor)
     task_scheduler: str = "THREADS"
@@ -299,11 +432,15 @@ class StandaloneQueryRunner:
             return txn
         check_ddl_access(stmt, self.access_control, self.session.user,
                          self.session.default_catalog)
+        sess = execute_session_stmt(stmt, self.session)
+        if sess is not None:
+            return sess
         if isinstance(stmt, ast.Explain):
             return self._execute_explain(stmt)
         if isinstance(stmt, ast.ShowTables):
             conn = self.catalog.connector(self.session.default_catalog)
-            return text_result("Table", conn.list_tables())
+            return text_result("Table", sorted(
+                list(conn.list_tables()) + list(self.catalog.views)))
         if isinstance(stmt, ast.ShowColumns):
             cat, table, schema = self.catalog.resolve_table(
                 stmt.table, self.session.default_catalog)
@@ -328,6 +465,7 @@ class StandaloneQueryRunner:
             dynamic_filtering=self.session.dynamic_filtering,
             hbm_limit_bytes=self.session.hbm_limit_bytes,
             spill_to_disk_bytes=self.session.spill_to_disk_bytes,
+            task_concurrency=self.session.task_concurrency,
         ).plan(plan)
         stats = QueryStats() if collect_stats else None
         with self.tracer.span("trino.execution"):

@@ -122,7 +122,24 @@ class Connector:
         raise NotImplementedError
 
     def get_table_statistics(self, table: str) -> TableStatistics:
-        return TableStatistics()
+        stats = getattr(self, "_analyzed_stats", {}).get(table)
+        return stats if stats is not None else TableStatistics()
+
+    def set_analyzed_statistics(self, table: str,
+                                stats: TableStatistics) -> None:
+        """ANALYZE writes collected stats here; connectors whose
+        get_table_statistics overrides should consult them first
+        (reference: the engine-computed stats StatisticsWriterOperator
+        hands back to ConnectorMetadata.finishStatisticsCollection)."""
+        if not hasattr(self, "_analyzed_stats"):
+            self._analyzed_stats = {}
+        self._analyzed_stats[table] = stats
+
+    def get_procedures(self) -> dict:
+        """name -> callable(**kwargs) (reference:
+        spi/procedure/Procedure.java; invoked by CALL)."""
+        return {}
+
 
     def column_dictionary(self, table: str, column: str) -> Optional[np.ndarray]:
         """Table-global sorted dictionary for a string column, if known."""

@@ -479,3 +479,54 @@ class ShowTables(Statement):
 @dataclass(frozen=True)
 class ShowColumns(Statement):
     table: str = ""
+
+
+@dataclass(frozen=True)
+class CreateView(Statement):
+    """CREATE [OR REPLACE] [MATERIALIZED] VIEW (reference:
+    execution/CreateViewTask.java, CreateMaterializedViewTask.java)."""
+
+    name: str = ""
+    query: "Query" = None
+    replace: bool = False
+    materialized: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Statement):
+    name: str = ""
+    if_exists: bool = False
+    materialized: bool = False
+
+
+@dataclass(frozen=True)
+class RefreshMaterializedView(Statement):
+    """REFRESH MATERIALIZED VIEW (reference:
+    operator/RefreshMaterializedViewOperator.java:27)."""
+
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class SetSession(Statement):
+    """SET SESSION prop = value (reference: execution/SetSessionTask.java)."""
+
+    name: str = ""
+    value: Expr = None
+
+
+@dataclass(frozen=True)
+class CallProcedure(Statement):
+    """CALL proc(args) (reference: spi/procedure/Procedure.java,
+    execution/CallTask.java)."""
+
+    name: str = ""
+    args: tuple = ()
+
+
+@dataclass(frozen=True)
+class Analyze(Statement):
+    """ANALYZE table (reference: execution/AnalyzeTask-equivalent flow via
+    StatisticsWriterOperator.java:35)."""
+
+    table: str = ""

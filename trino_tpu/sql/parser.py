@@ -177,6 +177,21 @@ class _Parser:
         if self.accept_kw("create"):
             if self.accept_word("function"):
                 return self._parse_create_function()
+            replace = False
+            if self.accept_word("or"):
+                self.expect_word("replace")
+                replace = True
+            if self.accept_word("materialized"):
+                self.expect_word("view")
+                name = self.qualified_name()
+                self.expect_kw("as")
+                return ast.CreateView(name, self.parse_query(), replace, True)
+            if self.accept_word("view"):
+                name = self.qualified_name()
+                self.expect_kw("as")
+                return ast.CreateView(name, self.parse_query(), replace, False)
+            if replace:
+                self.fail("OR REPLACE is supported for views only")
             self.expect_kw("table")
             name = self.qualified_name()
             if self.accept_op("("):
@@ -194,6 +209,18 @@ class _Parser:
         if self.accept_kw("drop"):
             if self.accept_word("function"):
                 return ast.DropFunction(self.qualified_name())
+            materialized = bool(self.accept_word("materialized"))
+            if materialized or self.peek_word("view"):
+                self.expect_word("view")
+                if_exists = False
+                save = self.i
+                if self.accept_word("if"):
+                    if self.accept_word("exists"):
+                        if_exists = True
+                    else:
+                        self.i = save
+                return ast.DropView(self.qualified_name(), if_exists,
+                                    materialized)
             self.expect_kw("table")
             if_exists = False
             save = self.i
@@ -212,6 +239,28 @@ class _Parser:
             self.expect_kw("into")
             name = self.qualified_name()
             return ast.InsertInto(name, self.parse_query())
+        if self.accept_word("refresh"):
+            self.expect_word("materialized")
+            self.expect_word("view")
+            return ast.RefreshMaterializedView(self.qualified_name())
+        if self.accept_word("set"):
+            self.expect_word("session")
+            name = self.qualified_name()
+            self.expect_op("=")
+            return ast.SetSession(name, self.parse_expr())
+        if self.accept_word("call"):
+            name = self.qualified_name()
+            args: list = []
+            self.expect_op("(")
+            if not self.peek_op(")"):
+                while True:
+                    args.append(self.parse_expr())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            return ast.CallProcedure(name, tuple(args))
+        if self.accept_kw("analyze"):
+            return ast.Analyze(self.qualified_name())
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
                 return ast.ShowTables()
@@ -900,6 +949,10 @@ class _Parser:
             self.advance()
             return t.text.lower()
         return None
+
+    def peek_word(self, *words: str) -> bool:
+        t = self.cur
+        return t.kind in ("kw", "ident") and t.text.lower() in words
 
     def expect_word(self, word: str) -> None:
         if not self.accept_word(word):

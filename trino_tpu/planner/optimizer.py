@@ -557,9 +557,45 @@ def _rewrite_filter_cluster(node: PlanNode, catalog: Catalog):
 
     est = [estimate_rows(l, catalog) for l in leaf_nodes]
 
-    # greedy: spine = largest; join smallest connected next
+    # greedy: spine = largest; next = the connected relation with the
+    # SMALLEST ESTIMATED JOIN OUTPUT (|A><B| ~ |A|*|B| / max key NDV —
+    # cost/JoinStatsRule's core rule).  Size-only greediness exploded Q5 at
+    # scale: customer joined the spine over the 25-value nationkey edge
+    # (fan-out x6000) before orders made the custkey edge available.
     order = [max(range(len(leaf_nodes)), key=lambda i: est[i])]
     remaining = set(range(len(leaf_nodes))) - set(order)
+    spine_est = est[order[0]]
+
+    ndv_cache: dict[tuple[int, int], Optional[float]] = {}
+
+    def _leaf_ndv(leaf: int, expr) -> Optional[float]:
+        if not isinstance(expr, InputRef):
+            return None
+        key = (leaf, expr.index)
+        if key not in ndv_cache:
+            ndv_cache[key] = _channel_ndv(leaf_nodes[leaf], expr.index,
+                                          catalog)
+        return ndv_cache[key]
+
+    def _edge_ndv(i: int) -> Optional[float]:
+        """max(NDV) over BOTH endpoints of the best usable edge
+        (|A><B| ~ |A|*|B| / max(ndv_A, ndv_B) — cost/JoinStatsRule)."""
+        best: Optional[float] = None
+        for (a, b, ea, eb) in edges:
+            if a in order and b == i:
+                se, ce = ea, eb
+                sl = a
+            elif b in order and a == i:
+                se, ce = eb, ea
+                sl = b
+            else:
+                continue
+            nd = max((x for x in (_leaf_ndv(i, ce), _leaf_ndv(sl, se))
+                      if x), default=None)
+            if nd:
+                best = max(best or 0.0, nd)
+        return best
+
     # key expressions must be channels; all edge endpoint exprs that are
     # plain InputRefs can be used directly, others appended via projection.
     while remaining:
@@ -568,8 +604,21 @@ def _rewrite_filter_cluster(node: PlanNode, catalog: Catalog):
             if any((a in order and b == i) or (b in order and a == i)
                    for (a, b, _, _) in edges)
         ]
-        pick = min(connected, key=lambda i: est[i]) if connected \
-            else min(remaining, key=lambda i: est[i])
+        if connected:
+
+            def out_est(i: int) -> float:
+                nd = _edge_ndv(i)
+                if nd:
+                    return spine_est * est[i] / max(nd, 1.0)
+                # keyed join with unknown NDV: PK-FK-ish assumption
+                return max(spine_est, est[i])
+
+            outs = {i: out_est(i) for i in connected}
+            pick = min(connected, key=lambda i: (outs[i], est[i]))
+            spine_est = max(outs[pick], 1.0)
+        else:
+            pick = min(remaining, key=lambda i: est[i])
+            spine_est = spine_est * max(est[pick], 1.0)  # cross join
         order.append(pick)
         remaining.discard(pick)
 

@@ -66,3 +66,17 @@ def test_engine_like_still_correct():
     rows2 = r.execute("select count(*) from customer "
                       "where c_mktsegment = 'BUILDING'").rows()
     assert rows == rows2 and rows[0][0] > 0
+
+
+def test_embedded_nul_falls_back_to_exact():
+    """Strings containing '\\x00' can't be measured from the codepoint
+    matrix (padding is also 0) — the vector path must defer to re
+    (advisor r4 low)."""
+    vals = {f"k{i}" for i in range(VECTOR_THRESHOLD + 10)}
+    vals |= {"a\x00b", "a\x00", "\x00", "ab", "a", "a\x00bXtail", "k1\x00"}
+    d = _dict(sorted(vals))
+    for pattern in ["a_b", "a%", "_", "ab", "a\x00b%"]:
+        got = like_mask(d, pattern)
+        want = _oracle(d, pattern)
+        diff = np.nonzero(got != want)[0]
+        assert not len(diff), (pattern, [repr(d[i]) for i in diff[:5]])

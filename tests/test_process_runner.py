@@ -120,3 +120,27 @@ def test_fte_worker_kill_recovers(runners):
             "the injected PROCESS_EXIT did not actually kill a worker"
     finally:
         fte.close()
+
+
+def test_internal_secret_required(runners):
+    """Mutating/descriptor-decoding endpoints reject requests that lack the
+    per-spawn shared secret (reference: InternalCommunicationConfig
+    sharedSecret); /v1/info stays open for liveness probes."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    dist, _ = runners
+    url = dist.workers[0].url
+    with urllib.request.urlopen(f"{url}/v1/info", timeout=10) as resp:
+        assert json.loads(resp.read())["state"] in ("ACTIVE", "SHUTTING_DOWN")
+    req = urllib.request.Request(
+        f"{url}/v1/task/evil", data=b"\x00" * 8, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 401
+    req = urllib.request.Request(
+        f"{url}/v1/task/evil/results/0/0", method="GET")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 401

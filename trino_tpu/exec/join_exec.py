@@ -201,6 +201,37 @@ def probe_ranges(table: DeviceJoinTable, probe_keys: Sequence[tuple],
 
 _PAIR_CACHE: dict = {}
 _PAIR_LOCK = threading.Lock()
+_PAIR_CACHE_MAX = 1024
+
+# dictionary identity tokens: monotonically assigned, NEVER recycled while
+# the dictionary object is alive (checked via weakref), so a cache key built
+# from tokens cannot alias a new dictionary at a recycled id() — which made
+# eviction unsafe in the r4 id()-keyed design (advisor r4 medium).  With
+# stable tokens the LRU eviction below is safe and nothing needs pinning.
+_DICT_TOKENS: dict[int, tuple] = {}  # id(d) -> (weakref|strong-thunk, token)
+_DICT_SEQ = 0
+
+
+def _dict_token(d):
+    global _DICT_SEQ
+    if d is None:
+        return None
+    import weakref
+
+    i = id(d)
+    ent = _DICT_TOKENS.get(i)
+    if ent is not None and ent[0]() is d:
+        return ent[1]
+    _DICT_SEQ += 1
+    tok = _DICT_SEQ
+    try:
+        # the collection callback fires before the id can be reused, so it
+        # cannot delete a newer entry — keeps the table bounded by LIVE dicts
+        ref = weakref.ref(d, lambda _r, _i=i: _DICT_TOKENS.pop(_i, None))
+    except TypeError:  # not weakrefable: keep it alive so the id can't recycle
+        ref = (lambda _d=d: _d)
+    _DICT_TOKENS[i] = (ref, tok)
+    return tok
 
 
 def _make_pair_fn(cap: int, num_keys: int, has_pvalid: tuple,
@@ -340,28 +371,25 @@ def run_pairs(table: DeviceJoinTable, lo, counts, total: int,
     has_remap = tuple(r is not None for r in remaps)
     pcol_has_valid = tuple(v is not None for _, v in probe_cols)
     bcol_has_valid = tuple(v is not None for _, v in build_cols)
-    key = (cap, len(probe_keys), has_pvalid, has_remap,
-           tuple(str(t) for t in pair_types),
-           tuple(id(d) if d is not None else None for d in pair_dicts),
-           len(probe_cols), len(build_cols), pcol_has_valid, bcol_has_valid,
-           residual, need_matched, semi)
     with _PAIR_LOCK:
-        hit = _PAIR_CACHE.get(key)
-    if hit is None:
+        key = (cap, len(probe_keys), has_pvalid, has_remap,
+               tuple(str(t) for t in pair_types),
+               tuple(_dict_token(d) for d in pair_dicts),
+               len(probe_cols), len(build_cols), pcol_has_valid,
+               bcol_has_valid, residual, need_matched, semi)
+        prog = _PAIR_CACHE.pop(key, None)
+        if prog is not None:  # re-insert: dict ordering = LRU order
+            _PAIR_CACHE[key] = prog
+    if prog is None:
         prog = _make_pair_fn(cap, len(probe_keys), has_pvalid, has_remap,
                              list(pair_types), list(pair_dicts),
                              len(probe_cols), len(build_cols),
                              pcol_has_valid, bcol_has_valid,
                              residual, need_matched, semi)
         with _PAIR_LOCK:
-            # the value holds pair_dicts: the id()-keyed component must not
-            # be recycled by the allocator while the entry lives
-            _PAIR_CACHE.setdefault(key, (prog, list(pair_dicts)))
-            if len(_PAIR_CACHE) > 1024:
+            prog = _PAIR_CACHE.setdefault(key, prog)
+            while len(_PAIR_CACHE) > _PAIR_CACHE_MAX:
                 _PAIR_CACHE.pop(next(iter(_PAIR_CACHE)))
-            prog = _PAIR_CACHE[key][0]
-    else:
-        prog = hit[0]
 
     flat: list = []
     for (d, v), r in zip(probe_keys, remaps):

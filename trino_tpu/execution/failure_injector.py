@@ -63,15 +63,23 @@ class FailureInjector:
                                 times))
 
     def consume_for(self, fragment_id: int, task_index: int,
-                    attempt: int) -> list[dict]:
+                    attempt: int, unreachable: frozenset = frozenset()
+                    ) -> list[dict]:
         """Wire form for ONE task-attempt descriptor.  A rule whose scope
         matches this attempt is counted as fired at export time (the worker
         cannot report back — it may be dead), so ``times`` bounds hold
-        identically in-process and across processes."""
+        identically in-process and across processes.  ``unreachable`` names
+        injection points this attempt can never reach (e.g. a leaf task
+        never reads upstream results); those rules are NOT consumed, so
+        they stay armed for an attempt that can hit them (advisor r4: an
+        exported-but-unreachable rule silently burned its ``times``
+        budget).  Unlisted/new kinds export by default."""
         out = []
         with self._lock:
             for r in self.rules:
                 if r.fired >= r.times:
+                    continue
+                if r.kind in unreachable:
                     continue
                 if ((r.fragment_id is None or r.fragment_id == fragment_id)
                         and (r.task_index is None

@@ -30,6 +30,7 @@ from typing import Optional
 from ..runner import QueryResult, Session
 from ..spi.batch import ColumnBatch
 from .distributed_runner import DistributedQueryRunner
+from .failure_injector import GET_RESULTS_FAILURE
 from .fragmenter import SubPlan
 from .serde import deserialize_batch
 from .worker import encode_descriptor
@@ -41,6 +42,13 @@ __all__ = ["HttpExchangeClient", "HttpRemoteTask",
 def _http(method: str, url: str, data: Optional[bytes] = None,
           timeout: float = 30.0):
     req = urllib.request.Request(url, data=data, method=method)
+    # per-spawn internal shared secret (reference: server/
+    # InternalCommunicationConfig.java:33 sharedSecret) — every node in the
+    # cluster process tree carries it via env; the worker rejects mutating
+    # or descriptor-decoding requests without it
+    secret = os.environ.get("TRINO_TPU_INTERNAL_SECRET")
+    if secret:
+        req.add_header("X-Trino-Internal-Bearer", secret)
     return urllib.request.urlopen(req, timeout=timeout)
 
 
@@ -127,10 +135,20 @@ class HttpRemoteTask:
             pass
 
 
+_SECRET_LOCK = threading.Lock()
+
+
 class WorkerProcess:
     """One spawned worker (python -m trino_tpu.execution.worker)."""
 
     def __init__(self, env_overrides: Optional[dict] = None):
+        # one shared secret per cluster process tree: minted on first spawn,
+        # inherited by every worker and by worker->worker exchange fetches
+        with _SECRET_LOCK:
+            if "TRINO_TPU_INTERNAL_SECRET" not in os.environ:
+                import secrets
+
+                os.environ["TRINO_TPU_INTERNAL_SECRET"] = secrets.token_hex(16)
         env = dict(os.environ)
         env.update(env_overrides or {})
         self.proc = subprocess.Popen(
@@ -229,7 +247,13 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                       "num_partitions": nparts},
             "spool_upstream": upstream,
             "failure_rules": (
-                injector.consume_for(fragment.id, task_index, attempt)
+                injector.consume_for(
+                    fragment.id, task_index, attempt,
+                    # a leaf attempt (no upstream) never reaches the
+                    # results-read injection point; new kinds export by
+                    # default
+                    unreachable=(set() if upstream
+                                 else {GET_RESULTS_FAILURE}))
                 if injector is not None else []),
         }
         rt = HttpRemoteTask(

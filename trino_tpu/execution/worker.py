@@ -67,9 +67,15 @@ class _Task:
 
 class TaskServer:
     def __init__(self, port: int = 0):
+        import os
+
         self.tasks: dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._draining = False
+        # per-spawn shared secret (reference: InternalCommunicationConfig
+        # sharedSecret): descriptors are pickles, so only the process tree
+        # holding the secret may reach any endpoint that decodes or mutates
+        self.secret = os.environ.get("TRINO_TPU_INTERNAL_SECRET")
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -124,6 +130,17 @@ class TaskServer:
         self.port = self.httpd.server_address[1]
 
     # ------------------------------------------------------------ handlers
+    def _authorized(self, h) -> bool:
+        import hmac
+
+        if self.secret is None:
+            return True
+        if hmac.compare_digest(
+                h.headers.get("X-Trino-Internal-Bearer") or "", self.secret):
+            return True
+        h._send(401, b'{"error": "missing or bad internal secret"}')
+        return False
+
     def _get(self, h) -> None:
         parts = [p for p in h.path.split("/") if p]
         if parts == ["v1", "info"]:
@@ -142,6 +159,8 @@ class TaskServer:
             return
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results":
+            if not self._authorized(h):
+                return
             self._get_results(h, parts[2], int(parts[4]), int(parts[5]))
             return
         h._send(404, b'{"error": "not found"}')
@@ -178,6 +197,8 @@ class TaskServer:
                 {"X-Next-Token": next_token, "X-Done": int(done)})
 
     def _post(self, h) -> None:
+        if not self._authorized(h):
+            return
         parts = [p for p in h.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             if self._draining:
@@ -201,6 +222,8 @@ class TaskServer:
         h._send(404, b'{"error": "not found"}')
 
     def _delete(self, h) -> None:
+        if not self._authorized(h):
+            return
         parts = [p for p in h.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             t = self.tasks.get(parts[2])
@@ -213,6 +236,8 @@ class TaskServer:
         h._send(404, b'{"error": "not found"}')
 
     def _put(self, h) -> None:
+        if not self._authorized(h):
+            return
         parts = [p for p in h.path.split("/") if p]
         if parts == ["v1", "shutdown"]:
             # graceful drain: refuse new tasks, exit once current ones end

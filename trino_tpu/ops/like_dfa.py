@@ -86,7 +86,13 @@ def like_mask(dictionary, pattern: str, escape: Optional[str] = None
         width = 1
     else:
         cp = arr.view(np.uint32).reshape(n, width)
-    lengths = (cp != 0).sum(axis=1)  # no interior NULs in python strs
+    lengths = (cp != 0).sum(axis=1)
+    # '\x00' detection: numpy's fixed-width storage both pads with and
+    # strips trailing NULs, so lengths[i] <= true length always — total
+    # equality implies elementwise equality, one scalar vs a per-entry
+    # python-length array on this hot path
+    if sum(len(str(v)) for v in dictionary) != int(lengths.sum()):
+        return _re_fallback(dictionary, pattern, escape)
 
     pct_bits = np.uint64(0)
     any_bits = np.uint64(0)  # tokens consuming any char: '_' and '%'

@@ -1,0 +1,10 @@
+def test_dbg():
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.runner import StandaloneQueryRunner
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+    print(r.execute('explain select o_totalprice / (o_totalprice - o_totalprice) from orders').rows())
+    try:
+        out = r.execute('select o_totalprice / (o_totalprice - o_totalprice) from orders')
+        print('no error, first rows:', out.rows()[:2])
+    except Exception as e:
+        print('raised:', type(e).__name__, e)

@@ -192,3 +192,87 @@ def test_durable_spool_survives_on_disk(tmp_path):
         "committed spools held no page bytes on disk"
     # cleaned up after the query
     assert not os.path.exists(seen[0])
+
+
+def test_fte_speculative_beats_straggler():
+    """A stalled task attempt is overtaken by a SPECULATIVE attempt (first
+    committed wins — TaskExecutionClass.java:19 + the event-driven
+    scheduler's speculation): the query finishes well before the stall
+    expires, and the speculative commit is observable."""
+    import time as _time
+
+    from trino_tpu.execution.failure_injector import TASK_STALL, FailureInjector
+    from trino_tpu.runner import StandaloneQueryRunner
+
+    catalog = default_catalog(scale_factor=0.01)
+    inj = FailureInjector()
+    # stall attempt 0 of task 0 in the first two (multi-task) stages; the
+    # speculative chain runs attempt_base=1000 and never matches the rule.
+    # (single-task stages cannot speculate — the trigger needs half the
+    # stage committed for a median duration estimate — so the root stays
+    # unstalled.)
+    inj.inject(TASK_STALL, task_index=0, attempt=0, times=2, stall_s=30.0)
+    session = Session(node_count=3, retry_policy="TASK",
+                      failure_injector=inj,
+                      fte_speculative_delay_s=0.1)
+    session.fte_events = []
+    dist = DistributedQueryRunner(catalog, worker_count=3, session=session)
+    sql = ("select o_orderpriority, count(*) c from orders "
+           "group by o_orderpriority order by 1")
+    expected = StandaloneQueryRunner(catalog).execute(sql).rows()
+    t0 = _time.perf_counter()
+    rows = dist.execute(sql).rows()
+    wall = _time.perf_counter() - t0
+    assert rows == expected
+    assert wall < 25.0, f"speculation never rescued the stall ({wall:.1f}s)"
+    kinds = [e[0] for e in session.fte_events]
+    assert "speculative_start" in kinds
+    assert any(e[0] == "commit" and e[3] == "SPECULATIVE"
+               for e in session.fte_events)
+
+
+def test_fte_memory_aware_retry():
+    """An attempt that dies on ExceededMemoryLimitError retries with an
+    exponentially larger memory budget
+    (ExponentialGrowthPartitionMemoryEstimator.java:55)."""
+    from trino_tpu.execution.failure_injector import TASK_OOM, FailureInjector
+    from trino_tpu.runner import StandaloneQueryRunner
+
+    catalog = default_catalog(scale_factor=0.01)
+    inj = FailureInjector()
+    inj.inject(TASK_OOM, task_index=0, attempt=0, times=1)
+    session = Session(node_count=2, retry_policy="TASK",
+                      failure_injector=inj)
+    session.fte_events = []
+    dist = DistributedQueryRunner(catalog, worker_count=2, session=session)
+    sql = "select count(*), sum(o_totalprice) from orders"
+    expected = StandaloneQueryRunner(catalog).execute(sql).rows()
+    assert dist.execute(sql).rows() == expected
+    mem_events = [e for e in session.fte_events if e[0] == "memory_retry"]
+    assert mem_events, "memory retry never escalated the budget"
+    assert mem_events[0][3] == 2.0  # default growth factor
+
+
+def test_fte_memory_multiplier_reaches_planner(monkeypatch):
+    """The grown budget really lands in the task's memory context."""
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    import trino_tpu.execution.distributed_runner as dr
+
+    seen = []
+    orig = dr.LocalPlanner
+
+    class SpyPlanner(orig):
+        def __init__(self, *a, **kw):
+            seen.append(kw.get("hbm_limit_bytes"))
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(dr, "LocalPlanner", SpyPlanner)
+    catalog = default_catalog(scale_factor=0.01)
+    session = Session(node_count=2, retry_policy="TASK",
+                      hbm_limit_bytes=1 << 20)
+    runner = DistributedQueryRunner(catalog, worker_count=2, session=session)
+    subplan = runner.create_subplan("select count(*) from nation")
+    frag = subplan.all_fragments()[0]
+    runner.fte_run_attempt(frag, 0, 1, 1, {}, __import__("tempfile").mkdtemp(),
+                           0, None, memory_multiplier=4.0)
+    assert (1 << 22) in seen

@@ -7,3 +7,12 @@ strings live as dictionary codes so devices only ever see fixed-width arrays.
 """
 
 __version__ = "0.1.0"
+
+# The engine's data plane is 64-bit (BIGINT/decimal lanes, uint64 hashes):
+# x64 must be on before ANY jnp array is created.  Importing the package is
+# the earliest common point — staging paths (device-side TPC-H generation,
+# pin_to_device) touch jnp before trino_tpu.ops would otherwise flip this.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+del _jax

@@ -259,6 +259,19 @@ class MemoryConnector(Connector):
             total_rows = 0
             pinned = []
             for b in batches:
+                already_dev = (b.columns
+                               and not isinstance(b.columns[0].data, _np.ndarray))
+                if already_dev:
+                    # born on device (device-side generation / jitted
+                    # pipeline output): keep it — a compact() here would
+                    # drag the whole table through the host tunnel
+                    lv = b.live
+                    if lv is None:
+                        lv = jnp.ones(b.num_rows, jnp.bool_)
+                    pinned.append(ColumnBatch(b.names, list(b.columns),
+                                              jax.device_put(jnp.asarray(lv))))
+                    total_rows += b.live_count
+                    continue
                 b = pad_to_bucket(b.compact())
                 total_rows += b.live_count
                 live = b.live

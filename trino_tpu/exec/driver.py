@@ -110,26 +110,76 @@ class Driver:
 def run_pipelines(pipelines: Sequence[Sequence[Operator]],
                   stats: Optional[QueryStats] = None) -> None:
     """Execute pipelines in dependency order (build sides first).
-    Consecutive sibling chains feeding the SAME LocalUnionBridge (the
-    intra-task local exchange — task_concurrency source drivers) run on
-    concurrent threads; numpy/XLA release the GIL inside kernels, so the
-    shards genuinely overlap."""
+    Pipelines belonging to one local-exchange cluster (tagged with the same
+    ``_concurrent_group`` on their source operator — producers, parallel
+    aggregation drivers AND the consumer chain) run on concurrent threads
+    with bounded buffers between them: a full buffer parks the producer, an
+    empty one parks the consumer, so memory stays bounded and the stages
+    genuinely pipeline (numpy/XLA release the GIL inside kernels).  The
+    legacy concurrent-union grouping (UnionSinkOperator with a concurrent
+    bridge) is kept for plain UNION chains."""
     import threading
 
     from .operators import UnionSinkOperator
 
-    def run_one(p) -> None:
+    def run_one(p, stop=None) -> None:
         ps = None
         if stats is not None:
             ps = PipelineStats()
             stats.pipelines.append(ps)
         Driver(p, ps).run()
 
+    def run_parked(p, stop=None) -> None:
+        """Drive to completion, sleeping briefly while parked on a bounded
+        buffer (the thread-pool analogue of isBlocked() futures).  ``stop``
+        aborts the loop when a sibling pipeline of the cluster failed."""
+        ps = None
+        if stats is not None:
+            ps = PipelineStats()
+            stats.pipelines.append(ps)
+        d = Driver(p, ps)
+        while True:
+            if stop is not None and stop.is_set():
+                return
+            status = d.process()
+            if status == "finished":
+                return
+            time.sleep(2e-4)
+
+    def run_group(group, runner) -> None:
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def wrapped(q):
+            try:
+                runner(q, stop)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()  # unpark siblings so the group can unwind
+
+        threads = [threading.Thread(target=wrapped, args=(q,),
+                                    daemon=True) for q in group]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
     i = 0
     n = len(pipelines)
     while i < n:
         p = pipelines[i]
         group = [p]
+        gid = getattr(p[0], "_concurrent_group", None)
+        if gid is not None:
+            while (i + 1 < n and getattr(
+                    pipelines[i + 1][0], "_concurrent_group", None) is gid):
+                i += 1
+                group.append(pipelines[i])
+            run_group(group, run_parked)
+            i += 1
+            continue
         if isinstance(p[-1], UnionSinkOperator) and p[-1].bridge.concurrent:
             bridge = p[-1].bridge
             while (i + 1 < n
@@ -138,22 +188,17 @@ def run_pipelines(pipelines: Sequence[Sequence[Operator]],
                 i += 1
                 group.append(pipelines[i])
         if len(group) > 1:
-            errors: list[BaseException] = []
-
-            def wrapped(q):
-                try:
-                    run_one(q)
-                except BaseException as e:  # noqa: BLE001
-                    errors.append(e)
-
-            threads = [threading.Thread(target=wrapped, args=(q,),
-                                        daemon=True) for q in group]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            if errors:
-                raise errors[0]
+            run_group(group, run_one)
         else:
             run_one(p)
         i += 1
+
+    # deferred masked-lane expression errors (DIVISION_BY_ZERO, overflow...)
+    # surface here: ONE batched scalar fetch across every operator of the
+    # task, raising before any result is returned (ops/expr.py error channel)
+    from ..ops.expr import check_error_scalars
+
+    check_error_scalars([
+        e for p in pipelines for op in p
+        for e in getattr(op, "pending_errors", ())
+    ])

@@ -36,6 +36,10 @@ class DynamicFilterHolder:
         self.dict_values: Optional[set] = None  # for dictionary columns
         self.has_nan = False  # build had NaN keys (NaN joins NaN here)
         self.rows_pruned = 0  # observability: how many probe rows we dropped
+        # device-resident domain, materialized on first probe_mask use (a
+        # blocking fetch at fill time cost ~140ms/build over the tunnel and
+        # bought nothing when every probe batch is device-pinned)
+        self._pending_device = None
 
     def fill_device(self, data, valid, live,
                     dictionary: Optional[np.ndarray]) -> None:
@@ -60,16 +64,27 @@ class DynamicFilterHolder:
                 valid = None if valid is None else np.asarray(valid)[keep]
             self.fill(np.asarray(data), valid, dictionary)
             return
-        import jax.numpy as jnp
-
         from .kernels import _device_domain
 
         dict_len = len(dictionary) if dictionary is not None else 0
-        out = jax.device_get(_device_domain(data, valid, live, dict_len))
-        cnt, cnt_nonnan, vmin, vmax, presence = out
+        out = _device_domain(data, valid, live, dict_len)
+        for a in jax.tree_util.tree_leaves(out):
+            try:  # start the transfer; the sync happens lazily if ever
+                a.copy_to_host_async()
+            except Exception:
+                pass
+        self._pending_device = (out, dictionary)
+        self.ready = True
+
+    def _materialize(self) -> None:
+        """Pull the device-computed domain to host (first probe_mask use)."""
+        import jax
+
+        out, dictionary = self._pending_device
+        self._pending_device = None
+        cnt, cnt_nonnan, vmin, vmax, presence = jax.device_get(out)
         if int(cnt) == 0:
             self.empty = True
-            self.ready = True
             return
         if dictionary is not None:
             self.dict_values = set(
@@ -79,7 +94,6 @@ class DynamicFilterHolder:
             if int(cnt_nonnan) > 0:
                 self.vmin = vmin
                 self.vmax = vmax
-        self.ready = True
 
     def fill(self, data: np.ndarray, valid: Optional[np.ndarray],
              dictionary: Optional[np.ndarray]) -> None:
@@ -118,6 +132,8 @@ class DynamicFilterHolder:
         NULL keys never match an equi-join, so they are dropped too."""
         if not self.ready:
             return None
+        if self._pending_device is not None:
+            self._materialize()
         data = np.asarray(data)
         if self.empty:
             return np.zeros(data.shape[0], bool)

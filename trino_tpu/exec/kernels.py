@@ -1341,6 +1341,55 @@ def _device_domain(data, valid, live, dict_len: int):
     return _domain_fn(valid is not None, live is not None, dict_len)(*flat)
 
 
+@lru_cache(maxsize=None)
+def _compact_fn(n_cols: int, valid_flags: tuple, has_live_out: bool, cap: int):
+    """Gather live rows to the front and slice to ``cap`` lanes (one stable
+    bool sort + gathers, all on device)."""
+
+    @jax.jit
+    def fn(live, *flat):
+        order = jnp.argsort(~live, stable=True)[:cap]
+        out = [x[order] for x in flat]
+        if has_live_out:
+            out.append(live[order])
+        return tuple(out)
+
+    return fn
+
+
+def compact_device_batch(batch, live_count: int):
+    """Compact a live-masked device batch down to bucket(live_count) lanes.
+    Dead lanes beyond the bucket are dropped; the (padded) tail keeps a live
+    mask.  Used by blocking operators whose cost is O(lanes log lanes): a
+    join output riding a fat probe shape with few survivors would otherwise
+    drag its dead lanes through every downstream sort."""
+    from ..spi.batch import Column, ColumnBatch
+
+    cap = bucket(max(live_count, 1))
+    if cap >= batch.num_rows:
+        return batch
+    flat = []
+    valid_flags = []
+    for c in batch.columns:
+        flat.append(jnp.asarray(c.data))
+        valid_flags.append(c.valid is not None)
+        if c.valid is not None:
+            flat.append(jnp.asarray(c.valid))
+    outs = _compact_fn(batch.num_columns, tuple(valid_flags), True, cap)(
+        jnp.asarray(batch.live), *flat)
+    cols = []
+    i = 0
+    for c, hv in zip(batch.columns, valid_flags):
+        d = outs[i]
+        i += 1
+        v = None
+        if hv:
+            v = outs[i]
+            i += 1
+        cols.append(Column(c.type, d, v, c.dictionary))
+    return ColumnBatch(batch.names, cols, outs[-1])
+
+
 def partition_assignments(keys: Sequence[tuple], num_partitions: int) -> np.ndarray:
     """Row -> partition id by key hash (NULL keys -> partition 0)."""
     datas = [jnp.asarray(d) for d, _ in keys]

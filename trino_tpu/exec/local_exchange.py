@@ -1,0 +1,203 @@
+"""Intra-task local exchange: bounded repartitioning between pipelines.
+
+The LocalExchange equivalent (reference: operator/exchange/LocalExchange.
+java:67, inserted by optimizations/AddLocalExchanges.java:111): N producer
+drivers deposit batches, M consumer drivers drain their partition, with a
+bounded per-consumer buffer providing BACKPRESSURE — a full buffer makes the
+sink decline input (``needs_input() == False``), which parks the producer
+driver instead of growing memory (the isBlocked() contract of
+operator/Operator.java:21).
+
+Modes (reference: PartitioningExchanger / RandomExchanger /
+PassthroughExchanger):
+
+- ``GATHER``      — all batches to consumer 0 (the many-to-one union).
+- ``PASSTHROUGH`` — producer i feeds consumer i % M, whole batches.
+- ``ROUND_ROBIN`` — whole batches rotate across consumers.
+- ``HASH``        — rows route by key hash.  Device-resident batches are
+  NOT moved: every consumer receives the same device arrays with a
+  partition-restricted ``live`` mask (an on-chip "exchange" is just a mask —
+  rows never leave HBM; the downstream blocking operator's live-compaction
+  shrinks its partition before any O(n log n) work).  Host batches
+  materialize per-partition compacted copies (numpy take).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..spi.batch import ColumnBatch
+from .operators import Operator
+
+__all__ = ["LocalExchange", "LocalExchangeSinkOperator",
+           "LocalExchangeSourceOperator"]
+
+GATHER = "GATHER"
+PASSTHROUGH = "PASSTHROUGH"
+ROUND_ROBIN = "ROUND_ROBIN"
+HASH = "HASH"
+
+
+class LocalExchange:
+    def __init__(self, n_producers: int, n_consumers: int, mode: str,
+                 key_channels: Sequence[int] = (),
+                 buffer_batches: int = 8):
+        self.n_producers = n_producers
+        self.n_consumers = n_consumers
+        self.mode = mode
+        self.key_channels = list(key_channels)
+        self.buffer_batches = buffer_batches
+        self._queues: list[deque] = [deque() for _ in range(n_consumers)]
+        self._lock = threading.Lock()
+        self._finished_producers = 0
+        self._rr = 0
+        self._partition_cache: dict = {}
+
+    # ------------------------------------------------------------- producers
+    def can_accept(self, producer_index: int) -> bool:
+        """False when a target buffer is full: the sink declines input and
+        the producer driver parks (bounded memory in every scheduler mode)."""
+        with self._lock:
+            if self.mode == PASSTHROUGH:
+                q = [self._queues[producer_index % self.n_consumers]]
+            else:
+                q = self._queues
+            return all(len(x) < self.buffer_batches for x in q)
+
+    def deposit(self, producer_index: int, batch: ColumnBatch) -> None:
+        if batch.num_rows == 0:
+            return
+        if self.mode == GATHER:
+            with self._lock:
+                self._queues[0].append(batch)
+            return
+        if self.mode == PASSTHROUGH:
+            with self._lock:
+                self._queues[producer_index % self.n_consumers].append(batch)
+            return
+        if self.mode == ROUND_ROBIN:
+            with self._lock:
+                self._queues[self._rr].append(batch)
+                self._rr = (self._rr + 1) % self.n_consumers
+            return
+        assert self.mode == HASH
+        parts = self._partition(batch)
+        with self._lock:
+            for j, sub in enumerate(parts):
+                if sub is not None and sub.num_rows:
+                    self._queues[j].append(sub)
+
+    def _partition(self, batch: ColumnBatch) -> list[Optional[ColumnBatch]]:
+        """Split by key hash.  Device batches split as shared-array live-mask
+        views (zero data movement on chip); host batches split as compacted
+        numpy copies."""
+        from . import kernels as K
+
+        m = self.n_consumers
+        keys = [(batch.columns[ch].data, batch.columns[ch].valid)
+                for ch in self.key_channels]
+        on_device = bool(batch.columns) and not isinstance(
+            batch.columns[0].data, np.ndarray)
+        if on_device:
+            import jax.numpy as jnp
+
+            h = K.hash_combine([jnp.asarray(d) for d, _ in keys])
+            part = (h % jnp.uint64(m)).astype(jnp.int32)
+            null_mask = None
+            for _, v in keys:
+                if v is not None:
+                    nm = ~jnp.asarray(v)
+                    null_mask = nm if null_mask is None else (null_mask | nm)
+            if null_mask is not None:
+                part = jnp.where(null_mask, 0, part)
+            live = (jnp.asarray(batch.live) if batch.live is not None
+                    else jnp.ones(batch.num_rows, jnp.bool_))
+            return [
+                ColumnBatch(batch.names, list(batch.columns),
+                            live & (part == j))
+                for j in range(m)
+            ]
+        part = K.partition_assignments(keys, m)
+        part = np.asarray(part)
+        if batch.live is not None:
+            alive = np.asarray(batch.live)
+        else:
+            alive = None
+        out: list[Optional[ColumnBatch]] = []
+        for j in range(m):
+            mask = part == j
+            if alive is not None:
+                mask = mask & alive
+            idx = np.nonzero(mask)[0]
+            if not len(idx):
+                out.append(None)
+                continue
+            cols = [c.take(idx) for c in batch.columns]
+            out.append(ColumnBatch(list(batch.names), cols))
+        return out
+
+    def producer_finished(self) -> None:
+        with self._lock:
+            self._finished_producers += 1
+
+    # ------------------------------------------------------------- consumers
+    def poll(self, consumer_index: int) -> Optional[ColumnBatch]:
+        with self._lock:
+            q = self._queues[consumer_index]
+            return q.popleft() if q else None
+
+    def consumer_finished(self, consumer_index: int) -> bool:
+        with self._lock:
+            return (self._finished_producers >= self.n_producers
+                    and not self._queues[consumer_index])
+
+
+class LocalExchangeSinkOperator(Operator):
+    """Terminal operator of a producer pipeline
+    (operator/exchange/LocalExchangeSinkOperator.java:31)."""
+
+    def __init__(self, exchange: LocalExchange, producer_index: int,
+                 names: Sequence[str]):
+        self.exchange = exchange
+        self.producer_index = producer_index
+        self.names = list(names)
+
+    def needs_input(self) -> bool:
+        return (super().needs_input()
+                and self.exchange.can_accept(self.producer_index))
+
+    def add_input(self, batch: ColumnBatch) -> None:
+        self.exchange.deposit(self.producer_index, batch.rename(self.names))
+
+    def finish_input(self) -> None:
+        super().finish_input()
+        self.exchange.producer_finished()
+
+    def is_finished(self) -> bool:
+        return self.input_done
+
+
+class LocalExchangeSourceOperator(Operator):
+    """Source operator of a consumer pipeline
+    (operator/exchange/LocalExchangeSourceOperator.java:27)."""
+
+    def __init__(self, exchange: LocalExchange, consumer_index: int):
+        self.exchange = exchange
+        self.consumer_index = consumer_index
+        self.input_done = True
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[ColumnBatch]:
+        if self._closed:
+            return None
+        return self.exchange.poll(self.consumer_index)
+
+    def is_finished(self) -> bool:
+        return self._closed or self.exchange.consumer_finished(
+            self.consumer_index)

@@ -104,21 +104,52 @@ class LocalPlanner:
             self.pipelines, collector, root.output_names, root.output_types)
 
     def _parallelize(self, pipeline: list[Operator]) -> list[list[Operator]]:
-        """Intra-task parallelism (LocalExchange.java:67 gather mode +
-        AddLocalExchanges.java:111): a pipeline whose source is a multi-
-        split scan forks into ``task_concurrency`` concurrent source driver
-        chains (scan shard + cloned filter/project programs), merged through
-        a LocalUnionBridge into the original downstream chain.  The driver
-        runner executes sibling chains on concurrent threads."""
+        """Intra-task parallelism (LocalExchange.java:67 +
+        AddLocalExchanges.java:111): a pipeline whose source is a multi-split
+        scan forks into ``task_concurrency`` parallel driver chains.  Row-
+        parallel operators (filter/project, INNER/LEFT/SINGLE lookup joins,
+        semi joins — all probing the shared build bridge) clone into every
+        chain; at the first grouped aggregation the rows cross a bounded
+        HASH local exchange into ``task_concurrency`` parallel aggregation
+        drivers (disjoint group spaces, so their outputs simply concatenate);
+        everything further downstream runs in one consumer chain behind a
+        GATHER exchange.  All pipelines of one exchange cluster are tagged
+        with a ``_concurrent_group`` id — the driver runner executes the
+        whole cluster concurrently with backpressure from the bounded
+        buffers."""
         if not isinstance(pipeline[0], ScanOperator):
             return [pipeline]
         scan = pipeline[0]
         c = min(self.task_concurrency, len(scan.splits))
         if c < 2:
             return [pipeline]
+        from .local_exchange import (
+            GATHER,
+            HASH,
+            LocalExchange,
+            LocalExchangeSinkOperator,
+            LocalExchangeSourceOperator,
+        )
+
+        def clone(op: Operator) -> Optional[Operator]:
+            if isinstance(op, FilterProjectOperator):
+                return FilterProjectOperator(
+                    op.predicate, op.projections,
+                    op.output_names, op.output_types)
+            if isinstance(op, LookupJoinOperator) and op.join_type in (
+                    "INNER", "LEFT", "SINGLE") and op.left_keys:
+                return LookupJoinOperator(
+                    op.bridge, op.left_keys, op.join_type, op.residual,
+                    op.output_names, op.output_types)
+            if isinstance(op, SemiJoinOperator) and op.source_keys:
+                return SemiJoinOperator(
+                    op.bridge, op.source_keys, op.null_aware, op.residual,
+                    op.output_names, op.output_types)
+            return None
+
         prefix = [scan]
         for op in pipeline[1:]:
-            if isinstance(op, FilterProjectOperator):
+            if clone(op) is not None:
                 prefix.append(op)
             else:
                 break
@@ -126,23 +157,44 @@ class LocalPlanner:
         if not rest:  # nothing downstream to feed (shouldn't happen)
             return [pipeline]
         last = prefix[-1]
-        names = (last.output_names if isinstance(last, FilterProjectOperator)
-                 else scan.columns)
-        bridge = LocalUnionBridge(c)
-        bridge.concurrent = True
+        names = (scan.columns if last is scan else last.output_names)
+
+        # partition point: grouped aggregation -> HASH exchange + c clones
+        agg = rest[0] if (isinstance(rest[0], HashAggregationOperator)
+                          and rest[0].group_keys) else None
+
+        gid = object()  # unique tag for this exchange cluster
+
+        def tag(p: list[Operator]) -> list[Operator]:
+            p[0]._concurrent_group = gid
+            return p
+
         chains: list[list[Operator]] = []
+        exch1 = LocalExchange(
+            c, c if agg is not None else 1,
+            HASH if agg is not None else GATHER,
+            key_channels=(agg.group_keys if agg is not None else ()))
         for i in range(c):
             shard = ScanOperator(
                 scan.connector, scan.splits[i::c], scan.columns,
                 dynamic_filters=scan.dynamic_filters,
                 constraint=scan.constraint, limit=scan.limit)
-            fps: list[Operator] = [
-                FilterProjectOperator(f.predicate, f.projections,
-                                      f.output_names, f.output_types)
-                for f in prefix[1:]
-            ]
-            chains.append([shard] + fps + [UnionSinkOperator(bridge, names)])
-        consumer: list[Operator] = [UnionSourceOperator(bridge)] + rest
+            ops: list[Operator] = [shard]
+            ops += [clone(op) for op in prefix[1:]]
+            ops.append(LocalExchangeSinkOperator(exch1, i, names))
+            chains.append(tag(ops))
+        if agg is None:
+            consumer = tag([LocalExchangeSourceOperator(exch1, 0)] + rest)
+            return chains + [consumer]
+        gather = LocalExchange(c, 1, GATHER)
+        for j in range(c):
+            agg_clone = HashAggregationOperator(
+                agg.group_keys, agg.aggs, agg.output_names,
+                agg.output_types, agg.step)
+            chains.append(tag([
+                LocalExchangeSourceOperator(exch1, j), agg_clone,
+                LocalExchangeSinkOperator(gather, j, agg.output_names)]))
+        consumer = tag([LocalExchangeSourceOperator(gather, 0)] + rest[1:])
         return chains + [consumer]
 
     # ------------------------------------------------------------------

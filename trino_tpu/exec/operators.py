@@ -345,6 +345,9 @@ class FilterProjectOperator(Operator):
         self._pending: Optional[ColumnBatch] = None
         self._compiled = None
         self._compiled_dicts = None
+        # device int32 scalars, one per batch whose program traced an
+        # error-capable op (division, overflow...); drained by the runner
+        self.pending_errors: list = []
 
     def _compile(self, batch: ColumnBatch):
         dicts = [c.dictionary for c in batch.columns]
@@ -381,28 +384,44 @@ class FilterProjectOperator(Operator):
         out_dtypes = [t.storage_dtype for t in self.output_types]
 
         def run(cols, live):
+            from ..ops.expr import (
+                expr_condition_mask,
+                expr_error_scope,
+                reduce_error_lanes,
+            )
+
             n = cols[0][0].shape[0]
-            if pred is not None:
-                data, valid = pred(cols)
-                mask = data if valid is None else data & valid
-                if getattr(mask, "ndim", 1) == 0:
-                    mask = jnp.broadcast_to(mask, (n,))
-                live = mask if live is None else live & mask
-            if projs is None:
-                return [(d, v) for d, v in cols], live
-            outs = []
-            for ce, dt in zip(projs, out_dtypes):
-                d, v = ce(cols)
-                d = jnp.asarray(d)
-                if d.ndim == 0:
-                    d = jnp.broadcast_to(d, (n,))
-                d = d.astype(dt)
-                if v is not None:
-                    v = jnp.asarray(v)
-                    if v.ndim == 0:
-                        v = jnp.broadcast_to(v, (n,))
-                outs.append((d, v))
-            return outs, live
+            with expr_error_scope() as errs:
+                if pred is not None:
+                    with expr_condition_mask(live):
+                        data, valid = pred(cols)
+                    mask = data if valid is None else data & valid
+                    if getattr(mask, "ndim", 1) == 0:
+                        mask = jnp.broadcast_to(mask, (n,))
+                    live = mask if live is None else live & mask
+                if projs is None:
+                    outs = [(d, v) for d, v in cols]
+                else:
+                    outs = []
+                    with expr_condition_mask(live):
+                        for ce, dt in zip(projs, out_dtypes):
+                            d, v = ce(cols)
+                            d = jnp.asarray(d)
+                            if d.ndim == 0:
+                                d = jnp.broadcast_to(d, (n,))
+                            d = d.astype(dt)
+                            if v is not None:
+                                v = jnp.asarray(v)
+                                if v.ndim == 0:
+                                    v = jnp.broadcast_to(v, (n,))
+                            outs.append((d, v))
+                err = reduce_error_lanes(errs, (n,))
+            # one int32 scalar (or None when nothing error-capable was
+            # traced); each recording already carries its lane mask (input
+            # live for the predicate, post-filter live for projections), so
+            # a filtered-out row can't raise but a failing WHERE clause can
+            err_code = None if err is None else jnp.max(err)
+            return outs, live, err_code
 
         self._compiled = (jax.jit(run), projs)
         self._compiled_dicts = dicts
@@ -420,7 +439,11 @@ class FilterProjectOperator(Operator):
             return
         batch = pad_to_bucket(batch)
         run, projs = self._compile(batch)
-        outs, live = run(_to_cols(batch), batch.live)
+        outs, live, err_code = run(_to_cols(batch), batch.live)
+        if err_code is not None:
+            # device scalar; checked in ONE batched fetch at pipeline end
+            # (run_pipelines -> ops.expr.check_error_scalars)
+            self.pending_errors.append(err_code)
         if projs is None:
             cols = [Column(c.type, d, v, c.dictionary)
                     for (d, v), c in zip(outs, batch.columns)]
@@ -458,6 +481,28 @@ class RenameOperator(Operator):
 
 # ---------------------------------------------------------------------------
 # memory-accounted input buffering (the revocable-memory participants)
+
+
+_COMPACT_FACTOR = 4  # compact when live rows < lanes/4
+_COMPACT_MIN_LANES = 1 << 16  # below this a count sync costs more than it saves
+
+
+def _maybe_compact_device(batch: ColumnBatch) -> ColumnBatch:
+    """Shrink a sparsely-live device batch to bucket(live) lanes before
+    O(lanes log lanes) work.  A selective join keeps its probe batch's fat
+    static shape (the sync-free contract of join_exec.run_unique); paying ONE
+    live-count sync here stops those dead lanes from riding through every
+    downstream sort.  Host batches and dense batches pass through."""
+    live = batch.live
+    if live is None or isinstance(live, np.ndarray):
+        return batch
+    n = batch.num_rows
+    if n < _COMPACT_MIN_LANES:
+        return batch
+    count = int(np.asarray(jnp.sum(jnp.asarray(live))))
+    if count * _COMPACT_FACTOR <= n:
+        return K.compact_device_batch(batch, count)
+    return batch
 
 
 class BufferedInputMixin:
@@ -839,7 +884,7 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         nk = len(self.group_keys)
         if not self.buffered_batches():
             return self._empty_result(nk)
-        inp = _concat_device(self._batches)
+        inp = _maybe_compact_device(_concat_device(self._batches))
         live = inp.live  # None = all rows real
         n = inp.num_rows
 
@@ -1182,6 +1227,9 @@ class JoinBuildSink(BufferedInputMixin, Operator):
 
         super().finish_input()
         if self.buffered_batches():
+            # no live-compaction here: the build program sorts dead rows
+            # last natively, and a count sync would cost more than the
+            # slightly fatter argsort it saves
             batch = _concat_device(self._batches)
         else:
             batch = ColumnBatch(self.names, [
@@ -1354,6 +1402,19 @@ class LookupJoinOperator(Operator):
             _probe_key_remap(probe.columns[ch], self.bridge.key_dicts[k])
             for k, ch in enumerate(self.left_keys)
         ]
+        if table.num_rows:
+            if self.join_type in ("INNER", "RIGHT"):
+                # speculative FK->PK probe: ranges+verify first, ONE combined
+                # (count, max-run) sync, then a width-adaptive gather; falls
+                # through to the pair path only when the build proved
+                # non-unique (exec/join_exec.py r5 design notes)
+                if self._add_inner_unique(probe, table, build, keys, remaps):
+                    return
+            elif table.unique:
+                # LEFT/SINGLE/FULL keep every probe row: the wide one-program
+                # path with zero per-batch syncs
+                self._add_unique_input(probe, table, build, keys, remaps)
+                return
         lo, counts, total = JX.probe_ranges(table, keys, remaps, probe.live)
         need_matched = self.join_type in ("LEFT", "SINGLE", "FULL")
         if self.join_type in ("RIGHT", "FULL"):
@@ -1403,6 +1464,89 @@ class LookupJoinOperator(Operator):
             ]
             self._pending.append(ColumnBatch(
                 self.output_names, list(probe.columns) + right_cols, un_live))
+
+    def _add_inner_unique(self, probe: ColumnBatch, table, build,
+                          keys, remaps) -> bool:
+        """INNER/RIGHT probe against a (speculatively) unique build.
+        Returns False when the build turned out non-unique — the caller
+        falls back to the general pair path."""
+        from . import join_exec as JX
+
+        ok_live, bid, cnt, mr = JX.run_unique_ranges(
+            table, keys, remaps, probe.live)
+        if mr > 1:
+            return False
+        if self.join_type == "RIGHT":
+            self._probe_dicts = [c.dictionary for c in probe.columns]
+        if cnt == 0:
+            return True  # nothing matched; RIGHT epilogue emits build rows
+        probe_cols = [(c.data, c.valid) for c in probe.columns]
+        build_cols = [(c.data, c.valid) for c in build.columns]
+        pair_types = ([c.type for c in probe.columns]
+                      + [c.type for c in build.columns])
+        pair_dicts = ([c.dictionary for c in probe.columns]
+                      + [c.dictionary for c in build.columns])
+        need_bm = self.join_type == "RIGHT"
+        p_out, b_out, live, bm = JX.run_unique_gather(
+            table, ok_live, bid, cnt, probe_cols, build_cols,
+            pair_types, pair_dicts, self.residual, need_bm)
+        if need_bm and bm is not None:
+            if self._build_matched is None:
+                self._build_matched = bm
+            else:
+                self._build_matched = jnp.asarray(self._build_matched) | bm
+        if p_out is None:  # wide: probe columns pass through untouched
+            left_cols = list(probe.columns)
+        else:
+            left_cols = [Column(c.type, d, v, c.dictionary)
+                         for c, (d, v) in zip(probe.columns, p_out)]
+        right_cols = [Column(c.type, d, v, c.dictionary)
+                      for c, (d, v) in zip(build.columns, b_out)]
+        self._pending.append(ColumnBatch(
+            self.output_names, left_cols + right_cols, live))
+        return True
+
+    def _add_unique_input(self, probe: ColumnBatch, table, build,
+                          keys, remaps) -> None:
+        """Unique-build probe: ONE program, probe columns pass through, the
+        output rides the probe batch's shape with the match mask as live.
+        Covers every join type: LEFT/SINGLE/FULL keep unmatched probe rows
+        as NULL-extended lanes of the same batch (no second batch), SINGLE
+        can never violate cardinality (<=1 match by construction)."""
+        from . import join_exec as JX
+
+        need_res_cols = self.residual is not None
+        probe_cols = ([(c.data, c.valid) for c in probe.columns]
+                      if need_res_cols else [])
+        build_cols = [(c.data, c.valid) for c in build.columns]
+        if need_res_cols:
+            pair_types = ([c.type for c in probe.columns]
+                          + [c.type for c in build.columns])
+            pair_dicts = ([c.dictionary for c in probe.columns]
+                          + [c.dictionary for c in build.columns])
+        else:
+            pair_types, pair_dicts = [], []
+        need_bm = self.join_type in ("RIGHT", "FULL")
+        bgather, ok_live, build_matched, _ = JX.run_unique(
+            table, keys, remaps, probe_cols, build_cols,
+            pair_types, pair_dicts, self.residual, need_bm,
+            live=probe.live)
+        if need_bm:
+            self._probe_dicts = [c.dictionary for c in probe.columns]
+            if self._build_matched is None:
+                self._build_matched = build_matched
+            else:
+                self._build_matched = (
+                    jnp.asarray(self._build_matched) | build_matched)
+        right_cols = [Column(c.type, d, v, c.dictionary)
+                      for c, (d, v) in zip(build.columns, bgather)]
+        if self.join_type in ("INNER", "RIGHT"):
+            out_live = ok_live
+        else:  # LEFT / SINGLE / FULL: unmatched probe rows stay live,
+            # their build columns already read NULL (valid folds the mask)
+            out_live = probe.live
+        self._pending.append(ColumnBatch(
+            self.output_names, list(probe.columns) + right_cols, out_live))
 
     _dense_build: Optional[ColumnBatch] = None  # set by the cross path
 
@@ -1507,9 +1651,28 @@ class SemiJoinOperator(Operator):
                      if k < len(self.bridge.key_dicts) else None)
             keys.append((c.data, c.valid))
             remaps.append(_probe_key_remap(c, bdict))
-        lo, counts, total = JX.probe_ranges(table, keys, remaps, batch.live)
         # IN over the empty set is FALSE (never UNKNOWN) even for NULL probes
         semi = (self.null_aware, table.has_null_key, table.live_rows > 0)
+        if table.unique:
+            if self.residual is not None:
+                probe_cols = [(c.data, c.valid) for c in batch.columns]
+                build_cols = [(c.data, c.valid) for c in build.columns]
+                pair_types = ([c.type for c in batch.columns]
+                              + [c.type for c in build.columns])
+                pair_dicts = ([c.dictionary for c in batch.columns]
+                              + [c.dictionary for c in build.columns])
+            else:
+                probe_cols, build_cols, pair_types, pair_dicts = [], [], [], []
+            _, _, _, mark_out = JX.run_unique(
+                table, keys, remaps, probe_cols, build_cols,
+                pair_types, pair_dicts, self.residual, False, semi=semi,
+                live=batch.live)
+            mark_data, mark_valid = mark_out
+            mark = Column(BOOLEAN, mark_data, mark_valid)
+            self._pending = ColumnBatch(
+                self.output_names, list(batch.columns) + [mark], batch.live)
+            return
+        lo, counts, total = JX.probe_ranges(table, keys, remaps, batch.live)
         if self.residual is not None:
             probe_cols = [(c.data, c.valid) for c in batch.columns]
             build_cols = [(c.data, c.valid) for c in build.columns]
@@ -1659,7 +1822,7 @@ class SortOperator(BufferedInputMixin, Operator):
     def _sorted_batch(self, batches: Sequence[ColumnBatch],
                       out_n: Optional[int]) -> ColumnBatch:
         if _any_device(batches):
-            inp = _concat_device(batches)
+            inp = _maybe_compact_device(_concat_device(batches))
             keys = [(inp.columns[k.channel].data, inp.columns[k.channel].valid,
                      k.ascending, k.nulls_first) for k in self.keys]
             cols = [(c.data, c.valid) for c in inp.columns]

@@ -265,10 +265,14 @@ class DistributedQueryRunner:
 
     def fte_run_attempt(self, fragment, task_index: int, task_count: int,
                         nparts: int, upstream: dict, spool_root: str,
-                        attempt: int, stats_sink: Optional[list]) -> str:
+                        attempt: int, stats_sink: Optional[list],
+                        memory_multiplier: float = 1.0) -> str:
         """Run ONE task attempt against the durable spool; returns the
         committed attempt directory.  In-process execution here; the
-        process runner overrides this with a worker-process dispatch."""
+        process runner overrides this with a worker-process dispatch.
+        ``memory_multiplier`` scales the task's HBM budget — the FTE
+        scheduler grows it exponentially after a memory failure
+        (ExponentialGrowthPartitionMemoryEstimator.java:55)."""
         import os as _os
 
         from .durable_spool import DurableSpoolClient, DurableSpoolWriter
@@ -278,6 +282,7 @@ class DistributedQueryRunner:
 
         injector = getattr(self.session, "failure_injector", None)
         if injector is not None:
+            injector.maybe_stall(fragment.id, task_index, attempt)
             injector.maybe_fail(TASK_FAILURE, fragment.id, task_index,
                                 attempt)
 
@@ -303,7 +308,8 @@ class DistributedQueryRunner:
             task_count=task_count,
             remote_clients=clients,
             dynamic_filtering=self.session.dynamic_filtering,
-            hbm_limit_bytes=self.session.hbm_limit_bytes,
+            hbm_limit_bytes=int(
+                self.session.hbm_limit_bytes * memory_multiplier),
         )
         local = planner.plan(fragment.root)
         task_dir = fte_task_dir(spool_root, fragment.id, task_index)
@@ -433,7 +439,8 @@ class DistributedQueryRunner:
                 for t in range(stage.task_count):
                     pipelines, stats = self._build_task(
                         stage, t, stages, stats_sink, collective)
-                    handles.append((f, t, executor.submit(pipelines, stats)))
+                    handles.append((f, t, executor.submit(pipelines, stats),
+                                    pipelines))
             # poll every handle so the FIRST failure aborts all buffers
             # immediately (matching THREADS-mode fail-fast)
             from .task import STALL_TIMEOUT_S
@@ -443,10 +450,22 @@ class DistributedQueryRunner:
             while pending and _time.monotonic() < deadline:
                 still = []
                 for i in pending:
-                    f, t, h = handles[i]
+                    f, t, h, pipelines = handles[i]
                     if not h.done.is_set():
                         still.append(i)
                         continue
+                    if h.error is None:
+                        # deferred expression errors (ops/expr.py channel):
+                        # checked per finished task, same as run_pipelines
+                        from ..ops.expr import check_error_scalars
+
+                        try:
+                            check_error_scalars([
+                                e for p in pipelines for op in p
+                                for e in getattr(op, "pending_errors", ())
+                            ])
+                        except Exception as err:  # noqa: BLE001
+                            h.error = err
                     if h.error is not None:
                         errors.append(h.error)
                         for s in stages.values():

@@ -21,11 +21,17 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 __all__ = ["FailureInjector", "InjectedFailure",
-           "TASK_FAILURE", "GET_RESULTS_FAILURE", "PROCESS_EXIT"]
+           "TASK_FAILURE", "GET_RESULTS_FAILURE", "PROCESS_EXIT",
+           "TASK_STALL", "TASK_OOM"]
 
 TASK_FAILURE = "TASK_FAILURE"
 GET_RESULTS_FAILURE = "GET_RESULTS_FAILURE"
 PROCESS_EXIT = "PROCESS_EXIT"
+# r5 additions for FTE tier 2 (reference: TaskExecutionClass.java:19
+# speculation is exercised with stalled tasks; memory-aware retry with
+# injected OOM — ExponentialGrowthPartitionMemoryEstimator.java:55):
+TASK_STALL = "TASK_STALL"  # sleep stall_s inside the task body
+TASK_OOM = "TASK_OOM"  # raise ExceededMemoryLimitError inside the task body
 
 
 class InjectedFailure(RuntimeError):
@@ -40,6 +46,7 @@ class _Rule:
     attempt: Optional[int] = None
     times: int = 1
     fired: int = 0
+    stall_s: float = 0.0  # TASK_STALL only
 
     def matches(self, kind: str, fragment_id: int, task_index: int,
                 attempt: int) -> bool:
@@ -58,9 +65,10 @@ class FailureInjector:
 
     def inject(self, kind: str, fragment_id: Optional[int] = None,
                task_index: Optional[int] = None,
-               attempt: Optional[int] = None, times: int = 1) -> None:
+               attempt: Optional[int] = None, times: int = 1,
+               stall_s: float = 0.0) -> None:
         self.rules.append(_Rule(kind, fragment_id, task_index, attempt,
-                                times))
+                                times, stall_s=stall_s))
 
     def consume_for(self, fragment_id: int, task_index: int,
                     attempt: int, unreachable: frozenset = frozenset()
@@ -93,13 +101,39 @@ class FailureInjector:
 
     def maybe_fail(self, kind: str, fragment_id: int, task_index: int,
                    attempt: int = 0) -> None:
+        # TASK_OOM fires at the task-body injection point (same site as
+        # TASK_FAILURE, different exception class)
+        kinds = (kind, TASK_OOM) if kind == TASK_FAILURE else (kind,)
         with self._lock:
             for r in self.rules:
-                if r.matches(kind, fragment_id, task_index, attempt):
+                if any(r.matches(k, fragment_id, task_index, attempt)
+                       for k in kinds):
                     r.fired += 1
+                    if r.kind == TASK_OOM:
+                        from ..spi.memory import ExceededMemoryLimitError
+
+                        raise ExceededMemoryLimitError(
+                            f"injected-oom f{fragment_id}.t{task_index}",
+                            1 << 40, 0)
                     raise InjectedFailure(
                         f"injected {kind} at f{fragment_id}.t{task_index} "
                         f"attempt {attempt}")
+
+    def maybe_stall(self, fragment_id: int, task_index: int,
+                    attempt: int = 0) -> None:
+        """Sleep (outside the lock) when a TASK_STALL rule matches — the
+        deterministic straggler for speculative-execution tests."""
+        delay = 0.0
+        with self._lock:
+            for r in self.rules:
+                if r.kind == TASK_STALL and r.matches(
+                        TASK_STALL, fragment_id, task_index, attempt):
+                    r.fired += 1
+                    delay = max(delay, r.stall_s)
+        if delay:
+            import time
+
+            time.sleep(delay)
 
 
 def check_wire_rules(rules: list[dict], kind: str, fragment_id: int,

@@ -63,47 +63,131 @@ def run_fte_query(runner, subplan: SubPlan,
     output_kinds = {f.id: f.output_kind for f in fragments}
     spool_root = make_spool_root(getattr(session, "fte_spool_dir", None))
 
+    speculative = getattr(session, "fte_speculative", True)
+    spec_min_delay = getattr(session, "fte_speculative_delay_s", 0.25)
+    mem_growth = getattr(session, "fte_memory_growth", 2.0)
+    # observability: ("commit", frag, task, kind) / ("memory_retry", frag,
+    # task, multiplier) / ("speculative_start", frag, task)
+    events = getattr(session, "fte_events", None)
+
+    def run_stage(f, tc: int, nparts: int, upstream: dict) -> list[str]:
+        """One stage with retry + speculation.  A SEPARATE function scope
+        per stage: a zombie thread (e.g. a stalled standard attempt whose
+        speculative twin already won) closes over THIS stage's state and can
+        never corrupt a later stage's bookkeeping (late-binding loop
+        closures did exactly that in the first r5 cut)."""
+        frag_commits: list[Optional[str]] = [None] * tc
+        failures: list[Optional[TaskFailure]] = [None] * tc
+        commit_lock = threading.Lock()
+        stage_t0 = time.perf_counter()
+        durations: list[float] = []
+
+        def commit(t: int, d: str, kind: str) -> None:
+            """First committed attempt wins (the spool's atomic-rename
+            dedup makes the loser's directory inert)."""
+            with commit_lock:
+                if frag_commits[t] is None:
+                    frag_commits[t] = d
+                    durations.append(time.perf_counter() - stage_t0)
+                    if events is not None:
+                        events.append(("commit", f.id, t, kind))
+
+        def run_attempts(t: int, attempt_base: int, kind: str) -> None:
+            """One retry chain (STANDARD or SPECULATIVE execution class —
+            TaskExecutionClass.java:19).  A memory failure grows the
+            task's budget exponentially on the next attempt
+            (ExponentialGrowthPartitionMemoryEstimator.java:55)."""
+            from ..spi.memory import ExceededMemoryLimitError
+
+            last: Optional[Exception] = None
+            mem_mult = 1.0
+            for attempt in range(attempts_allowed):
+                if frag_commits[t] is not None:
+                    return  # the twin already won
+                try:
+                    d = runner.fte_run_attempt(
+                        f, t, tc, nparts, upstream, spool_root,
+                        attempt_base + attempt, stats_sink,
+                        memory_multiplier=mem_mult)
+                    commit(t, d, kind)
+                    return
+                except Exception as e:  # retried; interrupts propagate
+                    last = e
+                    if isinstance(e, ExceededMemoryLimitError):
+                        mem_mult *= mem_growth
+                        if events is not None:
+                            events.append(
+                                ("memory_retry", f.id, t, mem_mult))
+                    time.sleep(0.01 * attempt)
+            if kind == "STANDARD":
+                failures[t] = TaskFailure(f.id, t, attempts_allowed, last)
+
+        # stage barrier between fragments, but a stage's tasks still run
+        # concurrently (matching Trino FTE's intra-stage parallelism)
+        threads = [threading.Thread(
+            target=run_attempts, args=(t, 0, "STANDARD"),
+            name=f"fte-{f.id}.{t}", daemon=True) for t in range(tc)]
+        for th in threads:
+            th.start()
+
+        # event loop: resolve tasks as they land; once half the stage
+        # committed, stragglers get a SPECULATIVE attempt chain (first
+        # commit wins).  A stalled standard attempt no longer holds the
+        # stage barrier hostage — its thread is left to die in the
+        # background (EventDrivenFaultTolerantQueryScheduler speculative
+        # semantics).
+        spec_threads: dict[int, threading.Thread] = {}
+        while True:
+            resolved = [
+                t for t in range(tc)
+                if frag_commits[t] is not None
+                or (failures[t] is not None
+                    and not (t in spec_threads
+                             and spec_threads[t].is_alive()))
+            ]
+            if len(resolved) == tc:
+                break
+            all_dead = all(not th.is_alive() for th in threads) and all(
+                not th.is_alive() for th in spec_threads.values())
+            if all_dead:
+                break
+            if speculative and durations and len(
+                    [t for t in range(tc)
+                     if frag_commits[t] is not None]) * 2 >= tc:
+                med = sorted(durations)[len(durations) // 2]
+                cutoff = max(2.0 * med, spec_min_delay)
+                now = time.perf_counter() - stage_t0
+                for t in range(tc):
+                    if (frag_commits[t] is None and t not in spec_threads
+                            and now > cutoff):
+                        if events is not None:
+                            events.append(("speculative_start", f.id, t))
+                        th = threading.Thread(
+                            target=run_attempts,
+                            args=(t, 1000, "SPECULATIVE"),
+                            name=f"fte-spec-{f.id}.{t}", daemon=True)
+                        spec_threads[t] = th
+                        th.start()
+            time.sleep(0.01)
+
+        for t in range(tc):
+            if frag_commits[t] is None:
+                raise failures[t] or TaskFailure(
+                    f.id, t, attempts_allowed,
+                    RuntimeError("task did not complete"))
+        return [d for d in frag_commits if d is not None]
+
     # fragment id -> list of committed attempt dirs (one per task)
     committed: dict[int, list[str]] = {}
     try:
         for f in fragments:
-            tc = task_counts[f.id]
-            nparts = consumer_tasks.get(f.id, 1)
             upstream = {
                 src: {"dirs": committed[src],
                       "merge": output_kinds[src] == "MERGE"}
                 for src in f.source_fragments
             }
-
-            frag_commits: list[Optional[str]] = [None] * tc
-            failures: list[Optional[TaskFailure]] = [None] * tc
-
-            def run_with_retry(t: int) -> None:
-                last: Optional[Exception] = None
-                for attempt in range(attempts_allowed):
-                    try:
-                        frag_commits[t] = runner.fte_run_attempt(
-                            f, t, tc, nparts, upstream, spool_root,
-                            attempt, stats_sink)
-                        return
-                    except Exception as e:  # retried; interrupts propagate
-                        last = e
-                        time.sleep(0.01 * attempt)
-                failures[t] = TaskFailure(f.id, t, attempts_allowed, last)
-
-            # stage barrier between fragments, but a stage's tasks still run
-            # concurrently (matching Trino FTE's intra-stage parallelism)
-            threads = [threading.Thread(target=run_with_retry, args=(t,),
-                                        name=f"fte-{f.id}.{t}", daemon=True)
-                       for t in range(tc)]
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
-            for fail in failures:
-                if fail is not None:
-                    raise fail
-            committed[f.id] = [d for d in frag_commits if d is not None]
+            committed[f.id] = run_stage(
+                f, task_counts[f.id], consumer_tasks.get(f.id, 1), upstream)
 
         from .durable_spool import DurableSpoolClient
 

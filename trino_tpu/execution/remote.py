@@ -214,7 +214,8 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
 
     def fte_run_attempt(self, fragment, task_index: int, task_count: int,
                         nparts: int, upstream: dict, spool_root: str,
-                        attempt: int, stats_sink: Optional[list]) -> str:
+                        attempt: int, stats_sink: Optional[list],
+                        memory_multiplier: float = 1.0) -> str:
         """Dispatch ONE FTE task attempt to a live worker PROCESS; the
         worker writes the durable spool (shared filesystem) and commits
         atomically.  A worker death mid-attempt surfaces here as GONE and
@@ -242,7 +243,8 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
             "splits_per_node": self.session.splits_per_node,
             "node_count": self.worker_count,
             "dynamic_filtering": self.session.dynamic_filtering,
-            "hbm_limit_bytes": self.session.hbm_limit_bytes,
+            "hbm_limit_bytes": int(
+                self.session.hbm_limit_bytes * memory_multiplier),
             "spool": {"task_dir": task_dir, "attempt": attempt,
                       "num_partitions": nparts},
             "spool_upstream": upstream,

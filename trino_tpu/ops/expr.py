@@ -25,6 +25,8 @@ Division/modulo by zero currently yields NULL rather than raising
 from __future__ import annotations
 
 import re
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
@@ -68,6 +70,98 @@ def _all_valids(vs):
     for v in vs:
         out = _and_valid(out, v)
     return out
+
+
+# ---------------------------------------------------------------------------
+# masked-lane error channel (reference: StandardErrorCode DIVISION_BY_ZERO /
+# NUMERIC_VALUE_OUT_OF_RANGE / INVALID_CAST_ARGUMENT).  Vectorized evaluation
+# computes every lane of every branch, so errors cannot raise eagerly: an
+# erroring op RECORDS a lane mask into the active scope instead, conditionals
+# ($if / $and / $or / coalesce) mask the lanes their branch doesn't select,
+# and the compiled program reduces the surviving lanes to one error-code
+# scalar that the runner checks (batched with the result fetch — a query
+# without error-capable ops pays nothing).
+
+DIVISION_BY_ZERO = 1
+NUMERIC_OUT_OF_RANGE = 2
+INVALID_CAST = 3
+ERROR_NAMES = {
+    DIVISION_BY_ZERO: "DIVISION_BY_ZERO: division by zero",
+    NUMERIC_OUT_OF_RANGE: "NUMERIC_VALUE_OUT_OF_RANGE: value out of range",
+    INVALID_CAST: "INVALID_CAST_ARGUMENT: invalid cast",
+}
+
+
+class QueryError(RuntimeError):
+    def __init__(self, code: int):
+        super().__init__(ERROR_NAMES.get(code, f"error code {code}"))
+        self.code = code
+
+
+class _ErrState(threading.local):
+    acc = None  # list[(code, lane_mask)] while a scope is active
+    mask = None  # current conditional lane mask (None = all lanes)
+
+
+_ERRS = _ErrState()
+
+
+@contextmanager
+def expr_error_scope():
+    """Collect (code, lanes) pairs recorded while tracing expression fns.
+    Active only inside a compiled program body — evaluation outside any
+    scope keeps the legacy NULL-on-error semantics."""
+    prev_acc, prev_mask = _ERRS.acc, _ERRS.mask
+    _ERRS.acc = acc = []
+    _ERRS.mask = None
+    try:
+        yield acc
+    finally:
+        _ERRS.acc, _ERRS.mask = prev_acc, prev_mask
+
+
+@contextmanager
+def expr_condition_mask(mask):
+    """Lanes where ``mask`` is False cannot raise (unselected branch /
+    filtered-out row)."""
+    prev = _ERRS.mask
+    if mask is not None:
+        _ERRS.mask = mask if prev is None else (prev & mask)
+    try:
+        yield
+    finally:
+        _ERRS.mask = prev
+
+
+def _record_error(code: int, lanes) -> None:
+    if _ERRS.acc is None:
+        return
+    if _ERRS.mask is not None:
+        lanes = lanes & _ERRS.mask
+    _ERRS.acc.append((code, lanes))
+
+
+def reduce_error_lanes(acc, shape):
+    """Combine a scope's recordings into ONE int32 lane array (0 = ok), or
+    None when nothing error-capable was traced."""
+    err = None
+    for code, lanes in acc:
+        lanes = jnp.broadcast_to(lanes, shape)
+        e = jnp.where(lanes, jnp.int32(code), jnp.int32(0))
+        err = e if err is None else jnp.maximum(err, e)
+    return err
+
+
+def check_error_scalars(scalars) -> None:
+    """One batched device fetch; raises QueryError on the worst code."""
+    if not scalars:
+        return
+    import jax
+
+    codes = [int(c) for c in jax.device_get(list(scalars))]
+    worst = max(codes)
+    if worst:
+        raise QueryError(worst)
 
 
 @dataclass
@@ -249,6 +343,8 @@ def _arith_handler(name: str):
                     num = av * (10**shift) if shift >= 0 else _round_half_up_div(av, 10**-shift)
                     safe_b = jnp.where(bv == 0, 1, bv)
                     data = _round_half_up_div(num, safe_b)
+                    _record_error(DIVISION_BY_ZERO, (bv == 0) if valid is None
+                                  else ((bv == 0) & valid))
                     valid = _and_valid(valid, bv != 0)
                 else:  # modulus
                     s = max(_scale_of(a.type), _scale_of(b.type))
@@ -256,30 +352,60 @@ def _arith_handler(name: str):
                     bv2 = _decimal_rescale(bv, _scale_of(b.type), s)
                     safe_b = jnp.where(bv2 == 0, 1, bv2)
                     data = av2 - _trunc_div(av2, safe_b) * bv2
+                    _record_error(DIVISION_BY_ZERO, (bv2 == 0) if valid is None
+                                  else ((bv2 == 0) & valid))
                     valid = _and_valid(valid, bv2 != 0)
                 return data, valid
             dtype = out_type.storage_dtype
             av = av.astype(dtype)
             bv = bv.astype(dtype)
+            is_int = bool(np.issubdtype(np.dtype(dtype), np.integer))
+
+            def ovf_err(ovf):
+                _record_error(NUMERIC_OUT_OF_RANGE,
+                              ovf if valid is None else (ovf & valid))
+
+            signed = bool(np.issubdtype(np.dtype(dtype), np.signedinteger))
             if name == "add":
                 data = av + bv
+                if signed:  # wraparound flips the sign against both operands
+                    ovf_err(((av ^ data) & (bv ^ data)) < 0)
             elif name == "subtract":
                 data = av - bv
+                if signed:
+                    ovf_err(((av ^ bv) & (av ^ data)) < 0)
             elif name == "multiply":
                 data = av * bv
+                if signed:  # wrapped product no longer divides back
+                    safe_a = jnp.where(av == 0, 1, av)
+                    ovf_err((av != 0) & (_trunc_div(data, safe_a) != bv))
             elif name == "divide":
-                if np.issubdtype(dtype, np.integer):
+                if is_int:
                     safe_b = jnp.where(bv == 0, 1, bv)
                     data = _trunc_div(av, safe_b)
+                    _record_error(DIVISION_BY_ZERO, (bv == 0) if valid is None
+                                  else ((bv == 0) & valid))
                     valid = _and_valid(valid, bv != 0)
                 else:
                     safe_b = jnp.where(bv == 0, 1.0, bv)
                     data = av / safe_b
+                    if (isinstance(a.type, DecimalType)
+                            or isinstance(b.type, DecimalType)
+                            or getattr(a.fn, "_from_decimal", False)
+                            or getattr(b.fn, "_from_decimal", False)):
+                        # decimal division folded to double still carries
+                        # exact-arithmetic semantics: /0 raises (Trino
+                        # DIVISION_BY_ZERO); pure double /0 stays NULL
+                        _record_error(
+                            DIVISION_BY_ZERO, (bv == 0) if valid is None
+                            else ((bv == 0) & valid))
                     valid = _and_valid(valid, bv != 0)
             else:  # modulus
                 safe_b = jnp.where(bv == 0, 1, bv)
-                if np.issubdtype(dtype, np.integer):
+                if is_int:
                     data = av - _trunc_div(av, safe_b) * bv
+                    _record_error(DIVISION_BY_ZERO, (bv == 0) if valid is None
+                                  else ((bv == 0) & valid))
                 else:
                     data = av - jnp.trunc(av / safe_b) * bv
                 valid = _and_valid(valid, bv != 0)
@@ -408,7 +534,10 @@ def _and_handler(out_type, args):
     def fn(cols: Cols):
         data, valid = None, None
         for a in args:
-            v, vv = a.fn(cols)
+            # short-circuit masking: once an earlier term is definite FALSE
+            # the remaining terms cannot raise on that lane
+            with expr_condition_mask(data):
+                v, vv = a.fn(cols)
             eff = v if vv is None else (v | ~vv)
             data = eff if data is None else (data & eff)
             valid = _and_valid(valid, vv)
@@ -424,7 +553,8 @@ def _or_handler(out_type, args):
     def fn(cols: Cols):
         data, valid = None, None
         for a in args:
-            v, vv = a.fn(cols)
+            with expr_condition_mask(None if data is None else ~data):
+                v, vv = a.fn(cols)
             eff = v if vv is None else (v & vv)
             data = eff if data is None else (data | eff)
             valid = _and_valid(valid, vv)
@@ -490,7 +620,12 @@ def _if_handler(out_type, args):
     def fn(cols: Cols):
         cv, cvalid = cond.fn(cols)
         take_true = cv if cvalid is None else (cv & cvalid)
-        (tv, tvalid), (fv, fvalid) = t2.fn(cols), f2.fn(cols)
+        # a branch's errors only count on the lanes that select it (CASE
+        # WHEN x = 0 THEN 0 ELSE 1/x END must not raise on x = 0 lanes)
+        with expr_condition_mask(take_true):
+            tv, tvalid = t2.fn(cols)
+        with expr_condition_mask(~take_true):
+            fv, fvalid = f2.fn(cols)
         data = jnp.where(take_true, tv, fv)
         if tvalid is None and fvalid is None:
             valid = None
@@ -514,7 +649,8 @@ def _coalesce_handler(out_type, args):
                 av, avalid = a2.fn(cols)
                 if avalid is None:
                     return av, None
-                pv, pvalid = prev.fn(cols)
+                with expr_condition_mask(~avalid):
+                    pv, pvalid = prev.fn(cols)
                 data = jnp.where(avalid, av, pv)
                 if pvalid is None:
                     return data, None  # fallback is never null
@@ -1067,6 +1203,11 @@ def _cast_handler(out_type, args):
             isinstance(out_type, DecimalType)
             or np.issubdtype(out_type.storage_dtype, np.integer)):
         fn._literal_value = rescale_scaled_int(int(a.fn._literal_value), ss, ds)
+    if isinstance(src, DecimalType) or getattr(a.fn, "_from_decimal", False):
+        # provenance marker: decimal operands folded to double keep exact-
+        # arithmetic error semantics (the analyzer casts decimal -> double
+        # before divide, which would otherwise hide DIVISION_BY_ZERO)
+        fn._from_decimal = True
     return Lowered(out_type, None, fn)
 
 

@@ -83,7 +83,8 @@ SETTABLE_SESSION_PROPERTIES = {
     "exchange_serde", "retry_policy", "task_retry_attempts",
     "task_scheduler", "executor_workers", "query_concurrency",
     "query_max_queued", "scale_writers", "writer_task_limit",
-    "task_concurrency",
+    "task_concurrency", "fte_speculative", "fte_speculative_delay_s",
+    "fte_memory_growth",
 }
 
 
@@ -382,6 +383,14 @@ class Session:
     failure_injector: object = None
     # base directory for the durable FTE spool (None = system temp)
     fte_spool_dir: object = None
+    # FTE tier 2 (reference: TaskExecutionClass.java:19 STANDARD/SPECULATIVE,
+    # ExponentialGrowthPartitionMemoryEstimator.java:55): stragglers get a
+    # speculative attempt once half the stage committed and the task exceeds
+    # max(2x median stage duration, fte_speculative_delay_s); a memory
+    # failure multiplies the next attempt's HBM budget by fte_memory_growth
+    fte_speculative: bool = True
+    fte_speculative_delay_s: float = 0.25
+    fte_memory_growth: float = 2.0
     # INSERT/CTAS fan out over round-robin writer tasks when the source is
     # large (SCALED_WRITER_* partitionings in miniature; planned by estimate)
     scale_writers: bool = False

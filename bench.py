@@ -172,9 +172,82 @@ def run_baseline() -> None:
     print(json.dumps(times))
 
 
+def run_scan_bench() -> None:
+    """`bench.py --scan`: the scan-ingest microbench.  Drains TPC-H lineitem
+    through ScanOperator three ways and reports GB/s from the ScanIngestStats
+    counters, so the ingest trajectory is tracked per round independently of
+    the full-query bench:
+
+    - ``legacy``:   the pre-PR synchronous path — string-materializing decode
+                    (TRINO_TPU_TPCH_VECTOR_DECODE=0), no prefetch.  This is
+                    the acceptance baseline.
+    - ``sync``:     vectorized decode, synchronous scan (TRINO_TPU_PREFETCH=0).
+    - ``prefetch``: vectorized decode + async prefetch/coalesce/staging.
+
+    ``vs_baseline`` in the JSON is prefetch over legacy.  Note prefetch vs
+    sync (``vs_sync``) only wins wall-clock when decode can overlap with
+    something — on a single-core host with the now-cheap vectorized decode it
+    hovers near 1.0; the ingest win lives in the decode itself and in
+    transfer/compute overlap during real queries.
+
+    Env knobs: BENCH_SCAN_SF (default 0.2), BENCH_SCAN_SPLITS (default 8),
+    plus the TRINO_TPU_PREFETCH_* family."""
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.exec.operators import ScanOperator
+
+    sf = float(os.environ.get("BENCH_SCAN_SF", "0.2"))
+    n_splits = int(os.environ.get("BENCH_SCAN_SPLITS", "8"))
+
+    def drain(tpch) -> tuple[float, "object"]:
+        cols = tpch.get_table_schema("lineitem").column_names()
+        splits = tpch.get_splits("lineitem", n_splits, 1)
+        scan = ScanOperator(tpch, splits, cols)
+        t0 = time.perf_counter()
+        while not scan.is_finished():
+            if scan.get_output() is None:
+                break
+        return time.perf_counter() - t0, scan.ingest_stats
+
+    results = {}
+    for mode, prefetch, vector in (("legacy", "0", "0"), ("sync", "0", "1"),
+                                   ("prefetch", "1", "1")):
+        os.environ["TRINO_TPU_PREFETCH"] = prefetch
+        os.environ["TRINO_TPU_TPCH_VECTOR_DECODE"] = vector
+        # fresh connector per leg: the decode flag is read at construction
+        tpch = default_catalog(scale_factor=sf).connector("tpch")
+        drain(tpch)  # warmup: dictionaries + code tables + jit caches
+        wall, stats = drain(tpch)
+        gbps = stats.scan_bytes / wall / 1e9
+        results[mode] = (wall, gbps, stats)
+        print(f"scan[{mode}]: {stats.scan_bytes/1e6:.1f} MB in "
+              f"{wall*1e3:.1f} ms = {gbps:.2f} GB/s | {stats.text()}",
+              file=sys.stderr)
+    os.environ.pop("TRINO_TPU_TPCH_VECTOR_DECODE", None)
+
+    st = results["prefetch"][2]
+    print(json.dumps({
+        "metric": f"scan_ingest_sf{sf:g}_gb_per_sec",
+        "value": round(results["prefetch"][1], 3),
+        "unit": "GB/s",
+        "vs_baseline": round(results["prefetch"][1] / results["legacy"][1], 3),
+        "vs_sync": round(results["prefetch"][1] / results["sync"][1], 3),
+        "legacy_gb_per_sec": round(results["legacy"][1], 3),
+        "sync_gb_per_sec": round(results["sync"][1], 3),
+        "queue_depth_max": st.queue_depth_max,
+        "queue_depth_avg": round(st.queue_depth_avg, 2),
+        "coalesced_batches": st.coalesced_batches,
+        "source_read_ms": round(st.source_read_s * 1e3, 1),
+        "consumer_wait_ms": round(st.consumer_wait_s * 1e3, 1),
+        "stage_ms": round(st.stage_s * 1e3, 1),
+    }))
+
+
 def main() -> None:
     if "--baseline" in sys.argv:
         run_baseline()
+        return
+    if "--scan" in sys.argv:
+        run_scan_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

@@ -24,6 +24,7 @@ lineitem/orders are ranges of *orders* so each split carries whole orders.
 from __future__ import annotations
 
 import datetime
+import os
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -263,6 +264,15 @@ class TpchConnector(Connector):
         self.batch_rows = batch_rows
         self._dict_cache: dict[tuple[str, str], np.ndarray] = {}
         self._building: set[tuple[str, str]] = set()
+        # vocab index -> sorted-dictionary code, per string column (the host
+        # twin of _DeviceTpchGen._code_table): batch decode becomes ONE
+        # integer gather instead of materializing python strings and binary-
+        # searching an object array per row (GIL-bound, ~75% of decode time)
+        self._code_tables: dict[tuple[str, str], tuple] = {}
+        # TRINO_TPU_TPCH_VECTOR_DECODE=0 keeps the legacy string-materializing
+        # decode — only useful as the bench baseline (bench.py --scan)
+        self._vector_decode = os.environ.get(
+            "TRINO_TPU_TPCH_VECTOR_DECODE", "1") != "0"
 
     # ---- sizes ----------------------------------------------------------
     def row_count(self, table: str) -> int:
@@ -386,10 +396,52 @@ class TpchConnector(Connector):
         codes = np.searchsorted(d, values).astype(np.int32)
         return Column(VARCHAR, codes, None, d)
 
+    def _code_table(self, table: str, column: str, vocab) -> tuple:
+        """(vocab-index -> code table, sorted dictionary), cached.  The
+        dictionary is the DATA-derived one from column_dictionary — identical
+        to the legacy decode, so small tables keep small dictionaries (nation
+        comments: 25 entries, not the 59k vocab — dictionary-space ops like
+        `||` depend on that).  Vocab entries absent from the data clip to an
+        arbitrary valid code; by construction they never occur."""
+        key = (table, column)
+        cached = self._code_tables.get(key)
+        if cached is None:
+            values = np.asarray(vocab, dtype=object)
+            d = self.column_dictionary(table, column)
+            tab = np.searchsorted(d, values).astype(np.int32)
+            np.clip(tab, 0, len(d) - 1, out=tab)
+            cached = (tab, d)
+            self._code_tables[key] = cached
+        return cached
+
     def _vocab_column(self, table: str, column: str, idx: np.ndarray,
                       vocab: list[str]) -> Column:
-        values = np.array(vocab, dtype=object)[np.asarray(idx, dtype=np.int64)]
-        return self._dict_column(table, column, values)
+        if (table, column) in self._building or not self._vector_decode:
+            values = np.array(vocab, dtype=object)[np.asarray(idx, np.int64)]
+            return self._dict_column(table, column, values)
+        tab, d = self._code_table(table, column, vocab)
+        return Column(VARCHAR, tab[np.asarray(idx, dtype=np.int64)], None, d)
+
+    def _comment_column(self, table: str, column: str, keys: np.ndarray,
+                        stream: int, phrase=None, phrase_ppm: int = 0) -> Column:
+        """Comment column without materializing strings: the same splitmix
+        index arithmetic as _device_comment_codes, mapped through the cached
+        code table over _comment_vocab (pure ufunc work — releases the GIL,
+        so prefetch threads genuinely parallelize the decode)."""
+        if (table, column) in self._building or not self._vector_decode:
+            return self._dict_column(
+                table, column, _comments(keys, stream, phrase, phrase_ppm))
+        w = len(_COMMENT_WORDS)
+        keys = keys.astype(np.uint64)
+        i1 = (_h64(keys, stream * 7 + 1) % _U(w)).astype(np.int64)
+        i2 = (_h64(keys, stream * 7 + 2) % _U(w)).astype(np.int64)
+        i3 = (_h64(keys, stream * 7 + 3) % _U(w)).astype(np.int64)
+        idx = (i1 * w + i2) * w + i3
+        if phrase and phrase_ppm:
+            hit = (_h64(keys, stream * 7 + 4) % _U(1_000_000)) < _U(phrase_ppm)
+            idx = np.where(hit, w * w * w + i1, idx)
+        tab, d = self._code_table(table, column, _comment_vocab(phrase))
+        return Column(VARCHAR, tab[idx], None, d)
 
     def _generate(self, table: str, columns: list[str], start: int, stop: int) -> ColumnBatch:
         gen = getattr(self, f"_gen_{table}")
@@ -405,8 +457,8 @@ class TpchConnector(Connector):
             elif c == "r_name":
                 out.append(self._vocab_column("region", "r_name", keys, _REGIONS))
             else:
-                out.append(self._dict_column("region", "r_comment",
-                                             _comments(keys.astype(np.uint64), 1)))
+                out.append(self._comment_column("region", "r_comment",
+                                                 keys.astype(np.uint64), 1))
         return ColumnBatch(list(columns), out)
 
     def _gen_nation(self, columns, start, stop):
@@ -422,8 +474,8 @@ class TpchConnector(Connector):
                 out.append(Column(BIGINT, np.array(
                     [_NATIONS[k][1] for k in keys], dtype=np.int64)))
             else:
-                out.append(self._dict_column("nation", "n_comment",
-                                             _comments(keys.astype(np.uint64), 2)))
+                out.append(self._comment_column("nation", "n_comment",
+                                                 keys.astype(np.uint64), 2))
         return ColumnBatch(list(columns), out)
 
     # supplier ------------------------------------------------------------
@@ -448,9 +500,9 @@ class TpchConnector(Connector):
             elif c == "s_acctbal":
                 out.append(Column(_DEC, _randint(keys, 32, -99999, 999999)))
             else:  # s_comment — 'Customer Complaints' at ~5 per 10k (Q16)
-                out.append(self._dict_column(
-                    "supplier", "s_comment",
-                    _comments(keys, 3, "Customer foo Complaints", 500)))
+                out.append(self._comment_column(
+                    "supplier", "s_comment", keys, 3,
+                    "Customer foo Complaints", 500))
         return ColumnBatch(list(columns), out)
 
     # customer ------------------------------------------------------------
@@ -478,8 +530,8 @@ class TpchConnector(Connector):
                 out.append(self._vocab_column("customer", "c_mktsegment",
                                               _randint(keys, 43, 0, 4), _SEGMENTS))
             else:
-                out.append(self._dict_column("customer", "c_comment",
-                                             _comments(keys, 4)))
+                out.append(self._comment_column("customer", "c_comment",
+                                                 keys, 4))
         return ColumnBatch(list(columns), out)
 
     # part ----------------------------------------------------------------
@@ -523,7 +575,7 @@ class TpchConnector(Connector):
             elif c == "p_retailprice":
                 out.append(Column(_DEC, _retail_price_cents(ik)))
             else:
-                out.append(self._dict_column("part", "p_comment", _comments(keys, 5)))
+                out.append(self._comment_column("part", "p_comment", keys, 5))
         return ColumnBatch(list(columns), out)
 
     # partsupp ------------------------------------------------------------
@@ -545,8 +597,8 @@ class TpchConnector(Connector):
             elif c == "ps_supplycost":
                 out.append(Column(_DEC, _randint(keys, 62, 100, 100000)))
             else:
-                out.append(self._dict_column("partsupp", "ps_comment",
-                                             _comments(keys, 6)))
+                out.append(self._comment_column("partsupp", "ps_comment",
+                                                 keys, 6))
         return ColumnBatch(list(columns), out)
 
     # orders --------------------------------------------------------------
@@ -609,9 +661,9 @@ class TpchConnector(Connector):
             elif c == "o_shippriority":
                 out.append(Column(BIGINT, np.zeros(len(ik), dtype=np.int64)))
             else:  # o_comment — 'special ... requests' ~1.3% (Q13)
-                out.append(self._dict_column(
-                    "orders", "o_comment",
-                    _comments(okeys, 8, "special foo requests", 13000)))
+                out.append(self._comment_column(
+                    "orders", "o_comment", okeys, 8,
+                    "special foo requests", 13000))
         return ColumnBatch(list(columns), out)
 
     # lineitem ------------------------------------------------------------
@@ -668,8 +720,8 @@ class TpchConnector(Connector):
                 out.append(self._vocab_column("lineitem", "l_shipmode",
                                               _randint(k, 31, 0, 6), _SHIPMODES))
             else:
-                out.append(self._dict_column("lineitem", "l_comment",
-                                             _comments(k, 9)))
+                out.append(self._comment_column("lineitem", "l_comment",
+                                                 k, 9))
         return ColumnBatch(list(columns), out)
 
 

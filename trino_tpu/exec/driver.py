@@ -16,9 +16,23 @@ import time
 from typing import Optional, Sequence
 
 from .operators import Operator
-from .stats import OperatorStats, PipelineStats, QueryStats
+from .stats import OperatorStats, PipelineStats, QueryStats, ScanIngestStats
 
-__all__ = ["Driver", "run_pipelines"]
+__all__ = ["Driver", "run_pipelines", "collect_scan_stats"]
+
+
+def collect_scan_stats(pipelines: Sequence[Sequence[Operator]]
+                       ) -> Optional[ScanIngestStats]:
+    """Roll up per-ScanOperator ingest counters (None if no scans ran)."""
+    total: Optional[ScanIngestStats] = None
+    for p in pipelines:
+        for op in p:
+            ingest = getattr(op, "ingest_stats", None)
+            if ingest is not None and ingest.scan_batches:
+                if total is None:
+                    total = ScanIngestStats()
+                total.merge(ingest)
+    return total
 
 
 class Driver:
@@ -192,6 +206,11 @@ def run_pipelines(pipelines: Sequence[Sequence[Operator]],
         else:
             run_one(p)
         i += 1
+
+    if stats is not None:
+        ingest = collect_scan_stats(pipelines)
+        if ingest is not None:
+            stats.merge_scan(ingest)
 
     # deferred masked-lane expression errors (DIVISION_BY_ZERO, overflow...)
     # surface here: ONE batched scalar fetch across every operator of the

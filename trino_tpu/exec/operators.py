@@ -36,6 +36,13 @@ from ..sql.ir import RowExpression
 from ..planner.plan import AggCall, SortKey, WindowFunc
 from . import kernels as K
 from . import window_kernels as WK
+from .prefetch import (
+    BatchCoalescer,
+    DeviceStager,
+    IngestConfig,
+    PrefetchingPageSource,
+)
+from .stats import ScanIngestStats
 
 __all__ = [
     "Operator",
@@ -100,7 +107,15 @@ class ScanOperator(Operator):
     """Reads splits via the connector page source (operator/
     TableScanOperator.java:46).  ``dynamic_filters`` [(column_idx, holder)]
     prune rows before padding/device transfer (the probe side of
-    DynamicFilterService — see exec/dynamic_filter.py)."""
+    DynamicFilterService — see exec/dynamic_filter.py).
+
+    With ``TRINO_TPU_PREFETCH=1`` (the default) the scan runs the async
+    ingest pipeline of exec/prefetch.py: splits decode on background
+    threads into a bounded queue, small batches coalesce up to the target
+    power-of-two bucket, and the next batch's ``jax.device_put`` is
+    dispatched while the previous one computes downstream.  With
+    ``TRINO_TPU_PREFETCH=0`` the synchronous one-split-at-a-time path below
+    runs bit-for-bit as before."""
 
     def __init__(self, connector: Connector, splits: Sequence[Split],
                  columns: Sequence[str], dynamic_filters=None,
@@ -122,6 +137,15 @@ class ScanOperator(Operator):
         self.rows_pruned_by_domain = 0
         self._source = None
         self.input_done = True
+        # -- async ingest state (exec/prefetch.py) --
+        self.ingest_cfg = IngestConfig.from_env()
+        self.ingest_stats = ScanIngestStats()
+        self._prefetcher: Optional[PrefetchingPageSource] = None
+        self._coalescer: Optional[BatchCoalescer] = None
+        self._stager: Optional[DeviceStager] = None
+        self._staged: Optional[ColumnBatch] = None
+        self._hold_back: Optional[ColumnBatch] = None
+        self._ingest_done = False
 
     def needs_input(self) -> bool:
         return False
@@ -151,6 +175,11 @@ class ScanOperator(Operator):
         return batch.filter(mask)
 
     def get_output(self) -> Optional[ColumnBatch]:
+        if self.ingest_cfg.enabled:
+            return self._get_output_async()
+        return self._get_output_sync()
+
+    def _get_output_sync(self) -> Optional[ColumnBatch]:
         while True:
             if self._closed:
                 return None
@@ -191,10 +220,98 @@ class ScanOperator(Operator):
                 # program compiles once per (pipeline, bucket)
                 if self.limit is not None and batch.live is None:
                     self._emitted_rows += batch.num_rows
+                self.ingest_stats.observe_batch(batch.nbytes, batch.num_rows)
                 return pad_to_bucket(batch)
 
+    # -- async ingest path --------------------------------------------------
+
+    def _ensure_ingest(self) -> None:
+        if self._prefetcher is not None or self._ingest_done:
+            return
+        self._prefetcher = PrefetchingPageSource(
+            self.connector, self.splits, self.columns,
+            constraint=self.constraint, config=self.ingest_cfg,
+            stats=self.ingest_stats, limit_rows=self.limit)
+        self.splits = []  # owned by the prefetcher now
+        self._coalescer = BatchCoalescer(
+            self.ingest_cfg.coalesce_rows, stats=self.ingest_stats)
+        self._stager = DeviceStager(stats=self.ingest_stats)
+
+    def _stage(self, batch: ColumnBatch) -> ColumnBatch:
+        if self.ingest_cfg.stage_device:
+            return self._stager.stage(batch)
+        return batch
+
+    def _produce_next(self) -> Optional[ColumnBatch]:
+        """One coalesced+staged batch, or None at end of input.  Filters run
+        consumer-side (holder counters are not thread-safe); a device-pinned
+        batch (``live`` set) flushes the coalescer first so row order holds,
+        then passes through like the sync path."""
+        if self._ingest_done:
+            return None
+        self._ensure_ingest()
+        while True:
+            if (self.limit is not None
+                    and self._emitted_rows >= self.limit):
+                # pushed-down LIMIT satisfied: abort prefetch, flush tail
+                self._prefetcher.close()
+                self._ingest_done = True
+                flushed = self._coalescer.flush()
+                return None if flushed is None else self._stage(flushed)
+            batch = self._prefetcher.get_next_batch()
+            if batch is None:
+                self._ingest_done = True
+                flushed = self._coalescer.flush()
+                return None if flushed is None else self._stage(flushed)
+            if batch.live is not None:
+                flushed = self._coalescer.flush()
+                if flushed is not None:
+                    self._hold_back = pad_to_bucket(batch)
+                    return self._stage(flushed)
+                return pad_to_bucket(batch)
+            if self.constraint is not None:
+                batch = self._apply_constraint(batch)
+            if self.dynamic_filters:
+                batch = self._apply_dynamic_filters(batch)
+            if batch.num_rows == 0:
+                continue
+            if self.limit is not None:
+                self._emitted_rows += batch.num_rows
+            self._coalescer.add(batch)
+            if self._coalescer.ready():
+                return self._stage(self._coalescer.flush())
+
+    def _get_output_async(self) -> Optional[ColumnBatch]:
+        if self._closed:
+            return None
+        if self._hold_back is not None:
+            out, self._hold_back = self._hold_back, None
+        elif self._staged is not None:
+            out, self._staged = self._staged, None
+        else:
+            out = self._produce_next()
+        # double buffering: dispatch the next batch's device transfer now so
+        # it overlaps downstream compute on `out`
+        if out is not None and self._staged is None \
+                and self._hold_back is None:
+            self._staged = self._produce_next()
+        return out
+
     def is_finished(self) -> bool:
-        return self._closed or (self._source is None and not self.splits)
+        if self._closed:
+            return True
+        if not self.ingest_cfg.enabled:
+            return self._source is None and not self.splits
+        if self._staged is not None or self._hold_back is not None:
+            return False
+        if self._prefetcher is None:
+            return self._ingest_done or not self.splits
+        return self._ingest_done and self._coalescer.buffered_rows == 0
+
+    def close(self) -> None:
+        super().close()
+        if self._prefetcher is not None:
+            self._prefetcher.close()  # drop in-flight + unclaimed splits
 
 
 class TableFunctionOperator(Operator):
@@ -547,30 +664,29 @@ class BufferedInputMixin:
             self._mem.update(self, 0)
 
     def _maybe_spill_to_disk(self) -> None:
-        """Third tier: host-buffered batches exceeding the session's disk
-        threshold go to a serde spill file (exec/spill.py)."""
+        """Third tier: buffered batches exceeding the session's disk
+        threshold go to a serde spill file (exec/spill.py).  Device-staged
+        batches count toward the threshold too — a disk limit is an explicit
+        request for bounded buffering, so they evict to host on the way down
+        (otherwise async-ingest scans would route every batch around this
+        tier as device arrays)."""
         limit = getattr(self._mem, "spill_to_disk_bytes", 0) if self._mem else 0
         if not limit:
             return
         batches = getattr(self, "_batches", None)
-        if not batches:
+        if not batches or not batches[0].columns:
             return
-        host_bytes = sum(
-            b.nbytes for b in batches if isinstance(b.columns[0].data, np.ndarray)
-        ) if batches and batches[0].columns else 0
-        if host_bytes <= limit:
+        if sum(b.nbytes for b in batches) <= limit:
             return
         from .spill import Spiller
 
         if getattr(self, "_spiller", None) is None:
             self._spiller = Spiller()
-        keep = []
         for b in batches:
-            if b.columns and isinstance(b.columns[0].data, np.ndarray):
-                self._spiller.spill(b)
-            else:
-                keep.append(b)
-        self._batches = keep
+            if not isinstance(b.columns[0].data, np.ndarray):
+                b = b.to_host()
+            self._spiller.spill(b)
+        self._batches = []
 
     def buffered_batches(self) -> list:
         """The operator's full input: disk-spilled pages restored first,

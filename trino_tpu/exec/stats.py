@@ -11,7 +11,91 @@ stats never forces a device sync on the hot path.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
+
+
+@dataclass
+class ScanIngestStats:
+    """Counters for the async scan-ingest pipeline (prefetch + coalesce +
+    device staging).  One instance per ScanOperator; ``merge`` folds shard
+    instances into the query-level roll-up rendered by QueryStats.text()."""
+
+    scan_bytes: int = 0          # host bytes produced by connector sources
+    scan_rows: int = 0
+    scan_batches: int = 0        # raw connector batches
+    coalesced_batches: int = 0   # merged batches emitted to the pipeline
+    coalesced_rows: int = 0
+    staged_batches: int = 0      # batches dispatched to device
+    splits_opened: int = 0
+    source_read_s: float = 0.0   # time inside connector get_next_batch
+    consumer_wait_s: float = 0.0  # consumer blocked waiting on prefetch
+    stage_s: float = 0.0         # device_put dispatch time
+    queue_depth_max: int = 0
+    queue_depth_sum: int = 0
+    queue_samples: int = 0
+    prefetch_enabled: bool = False
+    first_batch_t: float | None = None
+    last_batch_t: float | None = None
+
+    def observe_batch(self, nbytes: int, rows: int) -> None:
+        now = time.perf_counter()
+        if self.first_batch_t is None:
+            self.first_batch_t = now
+        self.last_batch_t = now
+        self.scan_bytes += nbytes
+        self.scan_rows += rows
+        self.scan_batches += 1
+
+    @property
+    def wall_s(self) -> float:
+        if self.first_batch_t is None or self.last_batch_t is None:
+            return 0.0
+        return self.last_batch_t - self.first_batch_t
+
+    @property
+    def gbps(self) -> float:
+        """Scan ingest GB/s over the first->last batch window."""
+        w = self.wall_s
+        return (self.scan_bytes / w) / 1e9 if w > 0 else 0.0
+
+    @property
+    def queue_depth_avg(self) -> float:
+        return self.queue_depth_sum / self.queue_samples if self.queue_samples else 0.0
+
+    def merge(self, other: "ScanIngestStats") -> None:
+        self.scan_bytes += other.scan_bytes
+        self.scan_rows += other.scan_rows
+        self.scan_batches += other.scan_batches
+        self.coalesced_batches += other.coalesced_batches
+        self.coalesced_rows += other.coalesced_rows
+        self.staged_batches += other.staged_batches
+        self.splits_opened += other.splits_opened
+        self.source_read_s += other.source_read_s
+        self.consumer_wait_s += other.consumer_wait_s
+        self.stage_s += other.stage_s
+        self.queue_depth_max = max(self.queue_depth_max, other.queue_depth_max)
+        self.queue_depth_sum += other.queue_depth_sum
+        self.queue_samples += other.queue_samples
+        self.prefetch_enabled = self.prefetch_enabled or other.prefetch_enabled
+        # overall window spans the earliest first batch to the latest last
+        for t in (other.first_batch_t,):
+            if t is not None and (self.first_batch_t is None or t < self.first_batch_t):
+                self.first_batch_t = t
+        for t in (other.last_batch_t,):
+            if t is not None and (self.last_batch_t is None or t > self.last_batch_t):
+                self.last_batch_t = t
+
+    def text(self) -> str:
+        mode = "prefetch" if self.prefetch_enabled else "sync"
+        return (
+            f"scan[{mode}]: {self.scan_bytes / 1e9:.3f} GB "
+            f"({self.scan_rows} rows, {self.scan_batches} batches -> "
+            f"{self.coalesced_batches} coalesced) @ {self.gbps:.2f} GB/s, "
+            f"queue depth avg {self.queue_depth_avg:.1f} max {self.queue_depth_max}, "
+            f"read {self.source_read_s * 1e3:.1f} ms / wait "
+            f"{self.consumer_wait_s * 1e3:.1f} ms / stage {self.stage_s * 1e3:.1f} ms"
+        )
 
 
 @dataclass
@@ -35,11 +119,19 @@ class QueryStats:
 
     label: str = ""
     pipelines: list[PipelineStats] = field(default_factory=list)
+    scan: ScanIngestStats | None = None
+
+    def merge_scan(self, ingest: ScanIngestStats) -> None:
+        if self.scan is None:
+            self.scan = ScanIngestStats()
+        self.scan.merge(ingest)
 
     def text(self) -> str:
         lines = []
         if self.label:
             lines.append(self.label)
+        if self.scan is not None and self.scan.scan_batches:
+            lines.append("  " + self.scan.text())
         for i, p in enumerate(self.pipelines):
             lines.append(f"  pipeline {i}:")
             for op in p.operators:

@@ -14,7 +14,27 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-__all__ = ["Span", "Tracer"]
+__all__ = ["Span", "Tracer", "annotate_scan_span"]
+
+
+def annotate_scan_span(span: "Span", ingest) -> None:
+    """Set the ``trino.scan.*`` attributes from a ScanIngestStats roll-up
+    (exec/stats.py) on an execution span, so exporters see scan throughput,
+    queue depth and transfer/compute overlap next to the wall time."""
+    if ingest is None or not ingest.scan_batches:
+        return
+    span.set("trino.scan.bytes", ingest.scan_bytes)
+    span.set("trino.scan.rows", ingest.scan_rows)
+    span.set("trino.scan.batches", ingest.scan_batches)
+    span.set("trino.scan.coalesced-batches", ingest.coalesced_batches)
+    span.set("trino.scan.gb-per-s", round(ingest.gbps, 3))
+    span.set("trino.scan.queue-depth-avg", round(ingest.queue_depth_avg, 2))
+    span.set("trino.scan.queue-depth-max", ingest.queue_depth_max)
+    span.set("trino.scan.source-read-ms", round(ingest.source_read_s * 1e3, 1))
+    span.set("trino.scan.consumer-wait-ms",
+             round(ingest.consumer_wait_s * 1e3, 1))
+    span.set("trino.scan.stage-ms", round(ingest.stage_s * 1e3, 1))
+    span.set("trino.scan.prefetch", ingest.prefetch_enabled)
 
 
 @dataclass

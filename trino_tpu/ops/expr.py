@@ -143,7 +143,11 @@ def _record_error(code: int, lanes) -> None:
 
 def reduce_error_lanes(acc, shape):
     """Combine a scope's recordings into ONE int32 lane array (0 = ok), or
-    None when nothing error-capable was traced."""
+    None when nothing error-capable was traced.  A zero-row shape (empty
+    partition / fully-pruned batch) has no lanes that can raise — return
+    None so callers never reduce over a zero-size array."""
+    if shape and int(shape[0]) == 0:
+        return None
     err = None
     for code, lanes in acc:
         lanes = jnp.broadcast_to(lanes, shape)

@@ -14,9 +14,10 @@ from typing import Optional
 import numpy as np
 
 from .connectors.catalog import Catalog, default_catalog
-from .exec.driver import run_pipelines
+from .exec.driver import collect_scan_stats, run_pipelines
 from .exec.local_planner import LocalPlanner
 from .exec.stats import QueryStats
+from .execution.tracing import annotate_scan_span
 from .planner.logical import LogicalPlanner
 from .planner.optimizer import optimize
 from .planner.plan import PlanNode, plan_text
@@ -477,8 +478,9 @@ class StandaloneQueryRunner:
             task_concurrency=self.session.task_concurrency,
         ).plan(plan)
         stats = QueryStats() if collect_stats else None
-        with self.tracer.span("trino.execution"):
+        with self.tracer.span("trino.execution") as sp:
             run_pipelines(local.pipelines, stats)
+            annotate_scan_span(sp, collect_scan_stats(local.pipelines))
         batches = local.collector.batches
         if batches:
             batch = ColumnBatch.concat(batches)

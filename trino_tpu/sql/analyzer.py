@@ -429,6 +429,12 @@ class Translator:
         left = self.translate(e.left)
         right = self.translate(e.right)
         name = self._OPNAMES[e.op]
+        # an untyped NULL operand takes the other side's type (both NULL ->
+        # bigint), so `1 / null` analyzes as bigint NULL instead of erroring
+        if left.type == UNKNOWN:
+            left = cast_to(left, right.type if right.type != UNKNOWN else BIGINT)
+        if right.type == UNKNOWN:
+            right = cast_to(right, left.type)
         lt, rt = left.type, right.type
         if lt == DATE and rt == DATE and name == "subtract":
             return Call(BIGINT, "subtract",

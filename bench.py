@@ -16,8 +16,12 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}:
 A bytes/s sanity line goes to stderr: scanned-bytes/s must stay below HBM
 peak (~0.8 TB/s on v5e) or the measurement is rejected as bogus.
 
-Env knobs: BENCH_SF (default 2), BENCH_ITERS (default 3),
+Env knobs: BENCH_SF (default 2; BENCH_SF=10 is the SF10 utilization profile
+leg — per-query rows/s, rows/s/chip and GB/s land in the JSON for
+BASELINE.md's honest-baseline tables), BENCH_ITERS (default 3),
 BENCH_BASELINE_WORKERS (default 8), BENCH_SKIP_BASELINE=1 to skip.
+An unusable accelerator backend falls back to JAX_PLATFORMS=cpu instead of
+failing (subprocess device probe, same pattern as __graft_entry__).
 """
 
 from __future__ import annotations
@@ -58,6 +62,34 @@ QUERIES = {"q1": Q1, "q3": Q3}
 TABLES = {"q1": ["lineitem"], "q3": ["customer", "orders", "lineitem"]}
 
 
+def _ensure_backend() -> None:
+    """Probe the configured JAX backend in a SUBPROCESS with a hard timeout
+    (same pattern as __graft_entry__._devices_usable: a wedged TPU plugin
+    hangs ``jax.devices()`` indefinitely and a libtpu/client mismatch only
+    surfaces at device_put), and fall back to JAX_PLATFORMS=cpu instead of
+    exiting rc=1 when the accelerator is unusable.  An explicit
+    JAX_PLATFORMS choice is respected as-is."""
+    if os.environ.get("JAX_PLATFORMS"):
+        return
+    code = (
+        "import numpy as np\n"
+        "import jax\n"
+        "d = jax.devices()[0]\n"
+        "jax.device_put(np.zeros(1), d).block_until_ready()\n"
+    )
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ),
+            capture_output=True, timeout=60.0,
+        ).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        ok = False
+    if not ok:
+        print("bench: accelerator backend unusable; falling back to "
+              "JAX_PLATFORMS=cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 def _enable_compile_cache() -> None:
     """Persist XLA compiles across bench processes (warmup dominates wall
     time on a tunneled device otherwise)."""
@@ -75,23 +107,23 @@ def _stage_memory_tables(sf: float):
     one consolidated batch per table (the warmed-table equivalent of the
     reference's benchto setup; big batches keep the per-batch dispatch and
     sync count off the measured path).  The big tables (orders/lineitem) are
-    generated ON the accelerator — the columns are born in HBM and staging
-    never pushes row data through the host<->device tunnel."""
-    import jax
-
+    generated ON the device — on an accelerator the columns are born in HBM
+    and staging never pushes row data through the host<->device tunnel; on
+    the CPU backend the same vectorized XLA generator is still orders of
+    magnitude faster than the per-row host page source (which made
+    BENCH_SF=10 staging run for hours on the fallback)."""
     from trino_tpu.connectors.catalog import default_catalog
     from trino_tpu.connectors.tpch import generate_table_device
     from trino_tpu.spi.batch import ColumnBatch
     from trino_tpu.spi.connector import TableSchema
 
-    on_accel = jax.default_backend() != "cpu"
     catalog = default_catalog(scale_factor=sf)
     tpch = catalog.connector("tpch")
     mem = catalog.connector("memory")
     for t in sorted({t for ts in TABLES.values() for t in ts}):
         schema = tpch.get_table_schema(t)
         cols = schema.column_names()
-        batch = generate_table_device(tpch, t, cols) if on_accel else None
+        batch = generate_table_device(tpch, t, cols)
         if batch is None:
             batches = []
             for s in tpch.get_splits(t, 4, 1):
@@ -252,20 +284,34 @@ def main() -> None:
 
     sf = float(os.environ.get("BENCH_SF", "2"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
+    _ensure_backend()
     _enable_compile_cache()
 
+    import jax
+
+    from trino_tpu.exec import syncguard
     from trino_tpu.runner import Session, StandaloneQueryRunner
 
     catalog = _stage_memory_tables(sf)
     runner = StandaloneQueryRunner(
         catalog, session=Session(default_catalog="memory", splits_per_node=1))
 
+    sync_before = syncguard.snapshot()
     times = _time_queries(runner, iters)
+    sync = syncguard.take_delta(sync_before)
+    chips = len(jax.devices()) if jax.default_backend() != "cpu" else 1
+    per_query: dict[str, dict] = {}
     total_rows = total_bytes = 0.0
     for name, sql in QUERIES.items():
         r, b = _scan_stats(runner, sql)
         total_rows += r
         total_bytes += b
+        per_query[name] = {
+            "wall_ms": round(times[name] * 1e3, 1),
+            "input_rows_per_sec": round(r / times[name]),
+            "input_rows_per_sec_per_chip": round(r / times[name] / chips),
+            "scan_gb_per_sec": round(b / times[name] / 1e9, 3),
+        }
     total_time = sum(times.values())
     rows_per_sec = total_rows / total_time
     bytes_per_sec = total_bytes / total_time
@@ -301,8 +347,17 @@ def main() -> None:
         "value": round(rows_per_sec),
         "unit": "rows/s",
         "vs_baseline": round(vs_baseline, 3),
+        "chips": chips,
         "per_query_ms": {q: round(t * 1e3, 1) for q, t in times.items()},
+        "per_query": per_query,
         "scan_gb_per_sec": round(bytes_per_sec / 1e9, 3),
+        "input_rows_per_sec_per_chip": round(rows_per_sec / chips),
+        # host-transfer counters over the timed region (exec/syncguard.py):
+        # the sync-free contract makes these flat in batch count
+        "host_syncs": sync.host_syncs,
+        "blocking_syncs": sync.blocking_syncs,
+        "hot_loop_syncs": sync.hot_loop_syncs,
+        "expand_overflows": sync.expand_overflows,
     }))
 
 

@@ -1,0 +1,358 @@
+"""Sync-free probe/expand hot loop: padded-expand equivalence against the
+legacy blocking paths, overflow->retry correctness, capacity planning, the
+deferred-commit OverflowQueue, and SyncGuard enforcement that steady-state
+probe batches perform ZERO blocking host syncs.
+
+Legacy switches kept precisely for these tests:
+  TRINO_TPU_LEGACY_EXPAND=1  kernels.probe_join_table two-fetch expand
+  TRINO_TPU_SYNC_FREE=0      operators.py per-batch blocking total sync
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.exec import join_exec as JX
+from trino_tpu.exec import kernels as K
+from trino_tpu.exec import syncguard as SG
+from trino_tpu.exec.operators import JoinBridge, JoinBuildSink, LookupJoinOperator
+from trino_tpu.spi import BIGINT, Column, ColumnBatch
+
+
+def _keys(arr, valid=None):
+    return [(np.asarray(arr), None if valid is None else np.asarray(valid))]
+
+
+def _pair_set(pi, bi):
+    return set(zip(np.asarray(pi).tolist(), np.asarray(bi).tolist()))
+
+
+def _expected_pairs(build, probe, bvalid=None, pvalid=None):
+    out = set()
+    for p, pv in enumerate(probe):
+        if pvalid is not None and not pvalid[p]:
+            continue
+        for b, bv in enumerate(build):
+            if bvalid is not None and not bvalid[b]:
+                continue
+            if pv == bv:
+                out.add((p, b))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kernels.probe_join_table: padded single-fetch vs legacy two-fetch
+
+
+def test_probe_join_table_padded_vs_legacy(monkeypatch):
+    rng = np.random.default_rng(3)
+    build = rng.integers(0, 50, size=300).astype(np.int64)  # heavy dups
+    bvalid = rng.random(300) > 0.1
+    probe = rng.integers(0, 60, size=257).astype(np.int64)  # some no-match
+    pvalid = rng.random(257) > 0.1
+    table = K.build_join_table([(build, bvalid)])
+
+    pi, bi = K.probe_join_table(table, [(probe, pvalid)])
+    monkeypatch.setenv("TRINO_TPU_LEGACY_EXPAND", "1")
+    pi_l, bi_l = K.probe_join_table(table, [(probe, pvalid)])
+
+    expected = _expected_pairs(build, probe, bvalid, pvalid)
+    assert _pair_set(pi, bi) == expected
+    assert _pair_set(pi_l, bi_l) == expected
+
+
+def test_probe_join_table_zero_match_and_empty(monkeypatch):
+    table = K.build_join_table(_keys(np.arange(10, dtype=np.int64)))
+    for env in ("0", "1"):
+        monkeypatch.setenv("TRINO_TPU_LEGACY_EXPAND", env)
+        # zero matches: every probe key outside the build domain
+        pi, bi = K.probe_join_table(
+            table, _keys(np.array([100, 200], dtype=np.int64)))
+        assert len(pi) == 0 and len(bi) == 0
+        # empty probe
+        pi, bi = K.probe_join_table(
+            table, _keys(np.empty(0, dtype=np.int64)))
+        assert len(pi) == 0 and len(bi) == 0
+
+
+def test_probe_join_table_overflow_retry():
+    # 4 probe rows * 64-duplicate build runs = 256 candidates, far beyond
+    # the speculative bucket(4) * _PAIR_PAD = 32 cap: the padded path must
+    # detect overflow and re-run at the exact bucket, never truncate
+    build = np.repeat(np.arange(2, dtype=np.int64), 64)
+    probe = np.array([0, 1, 0, 1], dtype=np.int64)
+    table = K.build_join_table(_keys(build))
+    before = SG.snapshot()
+    pi, bi = K.probe_join_table(table, _keys(probe))
+    delta = SG.take_delta(before)
+    assert delta.expand_overflows >= 1
+    assert _pair_set(pi, bi) == _expected_pairs(build, probe)
+
+
+# ---------------------------------------------------------------------------
+# join_exec.run_pairs: provable / estimated caps vs the legacy host total
+
+
+def _run_pairs_at(table, keys, cap, donate=False, total=None):
+    lo, counts, total_a = JX.probe_ranges_device(table, keys, [None])
+    t = total_a if total is None else total
+    probe = keys[0][0]
+    pairs, ok, matched, maxc, bid, overflow = JX.run_pairs(
+        table, lo, counts, t, keys, [None],
+        [(probe, None)], [(table.key_datas[0], None)],
+        [BIGINT, BIGINT], [None, None],
+        residual=None, need_matched=True, cap=cap, donate=donate)
+    return pairs, ok, bid, overflow
+
+
+def test_run_pairs_provable_cap_matches_legacy():
+    rng = np.random.default_rng(11)
+    # dup runs of 4 keep bucket(n_probe * max_run) within PROVABLE_SLACK of
+    # the probe width: the planner must prove the cap and skip the flag
+    build = np.repeat(np.arange(50, dtype=np.int64), 4)
+    probe = rng.integers(0, 60, size=128).astype(np.int64)
+    table = JX.build_table(_keys(build))
+    keys = _keys(probe)
+    expected = _expected_pairs(build, probe)
+
+    # legacy: blocking total sync picks the exact bucket
+    lo, counts, total = JX.probe_ranges(table, keys, [None])
+    pairs_l, ok_l, bid_l, _ = _run_pairs_at(table, keys, cap=None, total=total)
+    ok_l = np.asarray(ok_l)
+    # slot -> probe id comes back via the gathered probe column
+    pi_l = np.asarray(pairs_l[0][0])[ok_l]  # probe VALUES, so map via pairs
+    # reconstruct (probe_idx, build_idx) from gathered values + device ids
+    bid_l = np.asarray(bid_l)[ok_l]
+
+    # sync-free: planner cap from build-side stats (max_run), no total sync
+    planner = JX.ExpandPlanner()
+    cap, provable = planner.plan(len(probe), table.max_run)
+    assert provable  # run 4 * 128 probes = 512 lanes <= 8 * bucket(128)
+    pairs_s, ok_s, bid_s, overflow = _run_pairs_at(
+        table, keys, cap=cap, donate=provable)
+    ok_s = np.asarray(ok_s)
+    bid_s = np.asarray(bid_s)[ok_s]
+    assert not bool(np.asarray(overflow))
+
+    # both paths produce the same (probe value, build row) multiset, and
+    # the build rows of each must be exactly the expected pair set's
+    assert sorted(bid_l.tolist()) == sorted(bid_s.tolist())
+    assert set(bid_s.tolist()) == {b for _, b in expected}
+    assert sorted(np.asarray(pairs_s[0][0])[ok_s].tolist()) == \
+        sorted(pi_l.tolist())
+
+
+def test_run_pairs_overflow_flag_and_retry():
+    build = np.repeat(np.arange(4, dtype=np.int64), 32)  # runs of 32
+    probe = np.arange(4, dtype=np.int64)  # total = 4 * 32 = 128
+    table = JX.build_table(_keys(build))
+    keys = _keys(probe)
+
+    _, ok_t, _, overflow = _run_pairs_at(table, keys, cap=16)
+    assert bool(np.asarray(overflow))  # 128 candidates > 16 lanes: flagged
+    # the retry contract: re-run at the exact (now host-known) bucket
+    lo, counts, total_a = JX.probe_ranges_device(table, keys, [None])
+    total = int(total_a.get())
+    assert total == 128
+    pairs, ok, bid, overflow2 = _run_pairs_at(
+        table, keys, cap=K.bucket(total))
+    assert not bool(np.asarray(overflow2))
+    ok = np.asarray(ok)
+    assert int(ok.sum()) == 128
+    assert set(np.asarray(bid)[ok].tolist()) == set(range(len(build)))
+
+
+def test_run_pairs_empty_probe_zero_match():
+    build = np.arange(16, dtype=np.int64)
+    table = JX.build_table(_keys(build))
+    keys = _keys(np.array([100, 101], dtype=np.int64))
+    pairs, ok, bid, overflow = _run_pairs_at(table, keys, cap=8)
+    assert int(np.asarray(ok).sum()) == 0
+    assert not bool(np.asarray(overflow))
+
+
+# ---------------------------------------------------------------------------
+# capacity planning
+
+
+def test_planner_provable_for_unique_build():
+    cap, provable = JX.ExpandPlanner().plan(1024, max_run=1)
+    assert provable and cap == 1024
+
+
+def test_planner_estimates_then_crosses_bound():
+    p = JX.ExpandPlanner()
+    # bound = 16 * 1000 lanes >> PROVABLE_SLACK * bucket(16): not provable,
+    # first estimate falls back to the probe width
+    cap, provable = p.plan(16, max_run=1000)
+    assert not provable and cap == K.bucket(16)
+    # a landed total pushes the estimate past the provable bound: the
+    # planner snaps to the bound (never exceeds what can be proven needed)
+    p.observe(16000)
+    cap, provable = p.plan(16, max_run=1000)
+    assert provable and cap == K.bucket(16 * 1000)
+
+
+def test_planner_unknown_max_run_never_provable():
+    p = JX.ExpandPlanner()
+    cap, provable = p.plan(64, max_run=None)
+    assert not provable and cap == K.bucket(64)
+
+
+def test_plan_unique_cap():
+    assert JX.plan_unique_cap(1024, 10) == K.bucket(10)  # sparse: compact
+    assert JX.plan_unique_cap(1024, 800) is None  # dense: stay wide
+    assert JX.plan_unique_cap(1024, None) is None  # unknown: stay wide
+
+
+# ---------------------------------------------------------------------------
+# OverflowQueue: deferred commits, retry on landed-True flags
+
+
+def test_overflow_queue_commits_in_order_and_retries():
+    import jax.numpy as jnp
+
+    q = JX.OverflowQueue()
+    committed = []
+    retried = []
+
+    def entry(i, overflow):
+        def retry():
+            retried.append(i)
+            return f"retry-{i}"
+
+        q.push(SG.async_scalar(jnp.asarray(overflow), f"t{i}"),
+               f"spec-{i}", retry, committed.append)
+
+    before = SG.snapshot()
+    entry(0, False)
+    entry(1, True)  # truncated: must re-run, never commit the speculation
+    entry(2, False)
+    q.drain(block=True)
+    assert committed == ["spec-0", "retry-1", "spec-2"]
+    assert retried == [1]
+    assert SG.take_delta(before).expand_retries == 1
+    assert len(q) == 0
+
+
+def test_overflow_queue_blocks_past_max_inflight():
+    import jax.numpy as jnp
+
+    q = JX.OverflowQueue()
+    committed = []
+    for i in range(JX.MAX_INFLIGHT + 2):
+        q.push(SG.async_scalar(jnp.asarray(False), "t"), i, lambda: None,
+               committed.append)
+        q.drain()  # non-blocking: may or may not commit yet
+    assert len(q) <= JX.MAX_INFLIGHT + 1  # backpressure bound
+    q.drain(block=True)
+    assert committed == list(range(JX.MAX_INFLIGHT + 2))
+
+
+# ---------------------------------------------------------------------------
+# SyncGuard: steady-state probe batches are sync-free, and violations raise
+
+
+def test_forbidden_raises_inside_hot_region():
+    import jax.numpy as jnp
+
+    with SG.forbidden():
+        with SG.hot_region():
+            with pytest.raises(SG.SyncViolation):
+                SG.count_sync("test.tag", blocking=True)
+        # outside the hot region the same sync is fine
+        SG.count_sync("test.tag", blocking=True)
+    # non-blocking polls never violate
+    with SG.forbidden(), SG.hot_region():
+        h = SG.async_scalar(jnp.asarray(1), "test.poll")
+        h.get_if_ready()
+
+
+def _probe_driver(op, batch):
+    op.add_input(batch)
+    out = []
+    while (b := op.get_output()) is not None:
+        out.append(b.compact())
+    return out
+
+
+def test_lookup_join_steady_state_zero_hot_syncs():
+    """The acceptance contract: after warm-up, probe batches flow through
+    LookupJoinOperator with ZERO blocking host syncs — SyncGuard forbidden
+    mode raises on any violation, and the per-region counter stays 0."""
+    rng = np.random.default_rng(5)
+    nb = 3200
+    build_keys = np.repeat(np.arange(100, dtype=np.int64), 32)  # dup runs
+    build_vals = rng.integers(0, 1000, size=nb).astype(np.int64)
+    bridge = JoinBridge()
+    sink = JoinBuildSink(bridge, [0], [BIGINT, BIGINT], ["bk", "bv"])
+    sink.add_input(ColumnBatch(
+        ["bk", "bv"], [Column.from_values(BIGINT, build_keys.tolist()),
+                       Column.from_values(BIGINT, build_vals.tolist())]))
+    sink.finish_input()
+    op = LookupJoinOperator(bridge, [0], "INNER", None,
+                            ["pk", "pv", "bk", "bv"], [BIGINT] * 4)
+
+    def batch(seed):
+        r = np.random.default_rng(seed)
+        pk = r.integers(0, 110, size=1024).astype(np.int64)
+        return pk, ColumnBatch(
+            ["pk", "pv"], [Column.from_values(BIGINT, pk.tolist()),
+                           Column.from_values(BIGINT, pk.tolist())])
+
+    total_rows = 0
+    expected = 0
+    hits = np.bincount(build_keys, minlength=110)
+    # warm-up: jit compiles, planner converges, build scalars land
+    for seed in range(3):
+        pk, b = batch(seed)
+        expected += int(hits[pk].sum())
+        total_rows += sum(o.num_rows for o in _probe_driver(op, b))
+
+    # steady state: same shapes — any blocking sync inside the hot loop
+    # now raises SyncViolation, and the tally must stay at zero
+    before = SG.snapshot()
+    with SG.forbidden():
+        for seed in range(3, 8):
+            pk, b = batch(seed)
+            expected += int(hits[pk].sum())
+            total_rows += sum(o.num_rows for o in _probe_driver(op, b))
+    assert SG.take_delta(before).hot_loop_syncs == 0
+
+    op.finish_input()
+    while not op.is_finished():
+        b = op.get_output()
+        if b is not None:
+            total_rows += b.compact().num_rows
+    assert total_rows == expected
+
+
+# ---------------------------------------------------------------------------
+# query-level equivalence + observability
+
+
+@pytest.mark.parametrize("sql,expected_via", [
+    ("select count(*) from orders o join lineitem l "
+     "on o.o_orderkey = l.l_orderkey", None),
+    ("select count(*) from nation a join nation b "
+     "on a.n_regionkey = b.n_regionkey", [(125,)]),
+])
+def test_query_equivalence_sync_free_vs_legacy(monkeypatch, sql, expected_via):
+    from trino_tpu.runner import StandaloneQueryRunner
+
+    results = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("TRINO_TPU_SYNC_FREE", mode)
+        results[mode] = StandaloneQueryRunner().execute(sql).rows()
+    assert results["1"] == results["0"]
+    if expected_via is not None:
+        assert results["1"] == expected_via
+
+
+def test_explain_analyze_reports_sync_stats():
+    from trino_tpu.runner import StandaloneQueryRunner
+
+    r = StandaloneQueryRunner()
+    out = "\n".join(str(row[0]) for row in r.execute(
+        "explain analyze select count(*) from nation a join nation b "
+        "on a.n_regionkey = b.n_regionkey").rows())
+    assert "host syncs" in out

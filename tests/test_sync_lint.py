@@ -1,0 +1,54 @@
+"""Tier-1 wiring for tools/lint_host_sync.py: the exec hot path must not
+grow raw device->host scalar syncs (``int(np.asarray(...))``, ``.item()``,
+raw ``jax.device_get``) — every deliberate transfer goes through
+exec/syncguard.py where it is counted and hot-loop-enforced."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(ROOT, "tools", "lint_host_sync.py")
+
+
+def test_no_raw_host_syncs_in_exec():
+    proc = subprocess.run([sys.executable, LINT], capture_output=True,
+                          text=True, timeout=60)
+    assert proc.returncode == 0, \
+        f"raw host syncs crept into the exec hot path:\n{proc.stderr}"
+
+
+def test_lint_catches_planted_violation(tmp_path):
+    """The lint actually fires (guards against pattern rot)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import lint_host_sync as L
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "x = int(np.asarray(jnp.sum(a)))\n"
+        "y = a.item()\n"
+        "z = int(np.asarray(b))  # sync-ok: test pragma\n")
+    findings = L.lint_file(str(bad))
+    assert len(findings) == 2  # the pragma line is exempt
+    labels = {f[2] for f in findings}
+    assert any("int(np.asarray" in s for s in labels)
+    assert any(".item()" in s for s in labels)
+
+
+@pytest.mark.parametrize("pattern", [
+    "int(np.asarray(", "bool(np.asarray(", "float(np.asarray(",
+    ".item()", "jax.device_get(",
+])
+def test_patterns_cover_issue_list(pattern):
+    """Every pattern the sync-free contract names is covered."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import lint_host_sync as L
+    finally:
+        sys.path.pop(0)
+    line = f"v = {pattern}x)" if not pattern.startswith(".") else f"v = x{pattern}"
+    assert any(p.search(line) for p, _ in L.PATTERNS), pattern

@@ -134,7 +134,10 @@ def run_pipelines(pipelines: Sequence[Sequence[Operator]],
     bridge) is kept for plain UNION chains."""
     import threading
 
+    from . import syncguard
     from .operators import UnionSinkOperator
+
+    sync_before = syncguard.snapshot() if stats is not None else None
 
     def run_one(p, stop=None) -> None:
         ps = None
@@ -211,6 +214,7 @@ def run_pipelines(pipelines: Sequence[Sequence[Operator]],
         ingest = collect_scan_stats(pipelines)
         if ingest is not None:
             stats.merge_scan(ingest)
+        stats.merge_sync(syncguard.take_delta(sync_before))
 
     # deferred masked-lane expression errors (DIVISION_BY_ZERO, overflow...)
     # surface here: ONE batched scalar fetch across every operator of the

@@ -57,7 +57,10 @@ class DynamicFilterHolder:
             valid is None or isinstance(valid, np.ndarray)) and (
             live is None or isinstance(live, np.ndarray))
         if host_like or (n <= MAX_DISTINCT_SET and dictionary is None):
-            data, valid, live = jax.device_get((data, valid, live))
+            from . import syncguard as SG
+
+            data, valid, live = SG.fetch((data, valid, live),
+                                         "dynfilter.build-domain")
             if live is not None:
                 keep = np.asarray(live)
                 data = np.asarray(data)[keep]
@@ -82,7 +85,10 @@ class DynamicFilterHolder:
 
         out, dictionary = self._pending_device
         self._pending_device = None
-        cnt, cnt_nonnan, vmin, vmax, presence = jax.device_get(out)
+        from . import syncguard as SG
+
+        cnt, cnt_nonnan, vmin, vmax, presence = SG.fetch(
+            out, "dynfilter.materialize")
         if int(cnt) == 0:
             self.empty = True
             return

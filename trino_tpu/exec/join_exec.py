@@ -36,9 +36,11 @@ import numpy as np
 from ..ops.expr import compile_expression
 from ..sql.ir import RowExpression
 from . import kernels as K
+from . import syncguard as SG
 
-__all__ = ["DeviceJoinTable", "build_table", "probe_ranges", "run_pairs",
-           "run_unique"]
+__all__ = ["DeviceJoinTable", "build_table", "probe_ranges",
+           "probe_ranges_device", "run_pairs", "run_unique",
+           "ExpandPlanner", "OverflowQueue", "plan_unique_cap"]
 
 _SENT_BUILD = 0xFFFFFFFFFFFFFFFF  # build rows with NULL keys / dead rows
 _SENT_PROBE = 0xFFFFFFFFFFFFFFFE  # probe rows with NULL keys
@@ -79,8 +81,10 @@ class DeviceJoinTable:
                     isinstance(x, (bool, int)) for x in s):
                 self._fetched = s
             else:
+                # ONE blocking fetch per BUILD (never per probe batch); the
+                # async copy started at build time usually landed already
                 self._fetched = tuple(
-                    int(x) for x in jax.device_get(s))
+                    int(x) for x in SG.fetch(s, "join.build-scalars"))
         return self._fetched
 
     @property
@@ -97,6 +101,13 @@ class DeviceJoinTable:
         distinct): each probe row matches at most one build row, so the
         probe runs the static-shape path with no candidate-count sync."""
         return self._fetch()[2] <= 1
+
+    @property
+    def max_run(self) -> int:
+        """Longest duplicate-hash run among live build rows: each probe row
+        yields at most this many candidates, so n_probe * max_run bounds the
+        pair total — the provable padded-expand cap (ExpandPlanner)."""
+        return self._fetch()[2]
 
 
 @lru_cache(maxsize=None)
@@ -230,10 +241,16 @@ def build_table(keys: Sequence[tuple], live=None,
     rows — they never match and don't count toward live_rows/has_null."""
     if not keys:  # cross join: every probe row pairs with every live row
         n = int(num_rows or 0)
-        lr = n
         if live is not None:
-            lr = int(np.asarray(jnp.sum(jnp.asarray(live))))
-        return DeviceJoinTable(None, None, [], n, (False, lr, n))
+            # live count stays a device scalar: fetched lazily, per BUILD,
+            # via the table's one combined scalar sync — never per batch
+            lr = jnp.sum(jnp.asarray(live))
+            try:
+                lr.copy_to_host_async()
+            except Exception:
+                pass
+            return DeviceJoinTable(None, None, [], n, (False, lr, n))
+        return DeviceJoinTable(None, None, [], n, (False, n, n))
     has_valid = tuple(v is not None for _, v in keys)
     flat: list = []
     datas = []
@@ -310,12 +327,13 @@ def _ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
     return fn
 
 
-def probe_ranges(table: DeviceJoinTable, probe_keys: Sequence[tuple],
-                 remaps: Sequence[Optional[np.ndarray]], live=None):
+def probe_ranges_device(table: DeviceJoinTable, probe_keys: Sequence[tuple],
+                        remaps: Sequence[Optional[np.ndarray]], live=None):
     """probe_keys: [(data, valid|None), ...]; ``remaps[k]`` an optional
     host int32 table translating probe dictionary codes into the build code
-    space (-1 = value absent).  Returns (lo, counts, total:int) with
-    lo/counts on device — ONE host scalar sync."""
+    space (-1 = value absent).  Returns (lo, counts, total) with ALL THREE
+    on device — ZERO host syncs; ``total`` comes back as a SyncGuard
+    AsyncScalar whose D2H copy is already in flight."""
     has_valid = tuple(v is not None for _, v in probe_keys)
     has_remap = tuple(r is not None for r in remaps)
     flat: list = [table.sorted_hash]
@@ -329,7 +347,134 @@ def probe_ranges(table: DeviceJoinTable, probe_keys: Sequence[tuple],
         flat.append(jnp.asarray(live))
     lo, counts, total = _ranges_fn(
         len(probe_keys), has_valid, live is not None, has_remap)(*flat)
-    return lo, counts, int(total)
+    return lo, counts, SG.async_scalar(total, "join.pair-total")
+
+
+def probe_ranges(table: DeviceJoinTable, probe_keys: Sequence[tuple],
+                 remaps: Sequence[Optional[np.ndarray]], live=None):
+    """Legacy wrapper around :func:`probe_ranges_device` that syncs the
+    candidate total to a host int — ONE blocking host sync per call."""
+    lo, counts, total = probe_ranges_device(table, probe_keys, remaps, live)
+    return lo, counts, int(total.get())
+
+
+# ---------------------------------------------------------------------------
+# padded-expand capacity planning
+
+# the provable cap (n_probe * max_run lanes can NEVER overflow, because each
+# probe row yields at most max_run candidates) is used whenever it costs at
+# most this many times the minimal bucket; beyond that the adaptive estimate
+# takes over and the overflow flag guards correctness
+PROVABLE_SLACK = 8
+EST_HEADROOM = 2          # estimated cap = headroom * max recent total
+EST_WINDOW = 8            # totals remembered for the estimate
+
+
+class ExpandPlanner:
+    """Per-probe-operator planner for the padded-expand output bucket.
+
+    Sync-free contract: ``plan`` never touches the device.  It prefers a cap
+    PROVABLY >= the candidate total (from the build's max duplicate-hash
+    run — one scalar fetch per build, amortized over every batch), falling
+    back to an adaptive estimate fed by asynchronously-landed totals of
+    previous batches.  On the estimated path the caller must check the
+    expand program's overflow flag before emitting; ``observe`` feeds the
+    planner so steady state converges to zero overflows."""
+
+    __slots__ = ("_totals", "_pending")
+
+    def __init__(self):
+        self._totals: list[int] = []
+        self._pending: list[SG.AsyncScalar] = []
+
+    def plan(self, n_probe: int, max_run: Optional[int]) -> tuple[int, bool]:
+        """Returns (cap, provable).  ``max_run`` None = unknown (cross joins
+        or builds whose scalars were never fetched)."""
+        self._drain()
+        floor = K.bucket(max(n_probe, 1))
+        bound = None  # provable candidate-total upper bound
+        if max_run is not None and max_run >= 0:
+            bound = max(n_probe * max(max_run, 1), 1)
+            if K.bucket(bound) <= PROVABLE_SLACK * floor:
+                return K.bucket(bound), True
+        est = max(self._totals) * EST_HEADROOM if self._totals else n_probe
+        cap = K.bucket(max(est, n_probe, 1))
+        if bound is not None and cap >= K.bucket(bound):
+            return K.bucket(bound), True  # estimate crossed the bound
+        return cap, False
+
+    def observe_async(self, total: SG.AsyncScalar) -> None:
+        """Feed a batch's device total; it is read only once its async copy
+        landed (non-blocking polls on later ``plan`` calls)."""
+        self._pending.append(total)
+
+    def recent_max(self) -> Optional[int]:
+        """Largest asynchronously-landed total of the recent window (None
+        until the first one lands) — the unique-path density estimate."""
+        self._drain()
+        return max(self._totals) if self._totals else None
+
+    def observe(self, total: int) -> None:
+        self._totals.append(int(total))
+        del self._totals[:-EST_WINDOW]
+
+    def _drain(self) -> None:
+        still = []
+        for h in self._pending:
+            v = h.get_if_ready()
+            if v is None:
+                still.append(h)
+            else:
+                self.observe(int(v))
+        self._pending = still[-EST_WINDOW:]
+
+
+MAX_INFLIGHT = 4  # deferred estimated-cap batches before the host backs off
+
+
+class OverflowQueue:
+    """Deferred commits for estimated-cap expand programs.
+
+    An estimated cap can truncate candidates, and the only proof it didn't
+    is the program's device overflow flag — but blocking on that flag per
+    batch would reintroduce exactly the sync the padded expand removed.  So
+    the speculative result parks here with the flag's async copy in flight;
+    the flag of batch N lands while the host dispatches batch N+1, and
+    ``drain`` commits it with a non-blocking poll.  The rare landed-True
+    entry re-runs via its ``retry`` thunk at the exact (by then host-known)
+    total before committing — results are never silently truncated, and the
+    retry is counted in SyncStats (``expand_overflows``/``expand_retries``).
+
+    Entries commit in push order; only ``drain(block=True)`` (input end /
+    more than MAX_INFLIGHT parked) ever blocks."""
+
+    __slots__ = ("_q",)
+
+    def __init__(self):
+        from collections import deque
+
+        self._q = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, overflow: SG.AsyncScalar, result, retry, commit) -> None:
+        self._q.append((overflow, result, retry, commit))
+
+    def drain(self, block: bool = False) -> None:
+        while self._q:
+            h, res, retry, commit = self._q[0]
+            if block or len(self._q) > MAX_INFLIGHT:
+                v = h.get()
+            else:
+                v = h.get_if_ready()
+                if v is None:
+                    return
+            self._q.popleft()
+            if bool(v):
+                SG.count_overflow()
+                res = retry()
+            commit(res)
 
 
 # ---------------------------------------------------------------------------
@@ -370,18 +515,33 @@ def _dict_token(d):
     return tok
 
 
+def _donate_ok() -> bool:
+    """Buffer donation saves HBM on real accelerators; the CPU backend warns
+    about unusable donations, so only donate off-CPU."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
 def _make_pair_fn(cap: int, num_keys: int, has_pvalid: tuple,
                   has_remap: tuple, pair_types, pair_dicts,
                   n_probe_cols: int, n_build_cols: int,
                   pcol_has_valid: tuple, bcol_has_valid: tuple,
                   residual: Optional[RowExpression],
-                  need_matched: bool, semi: Optional[tuple]):
+                  need_matched: bool, semi: Optional[tuple],
+                  donate: bool = False):
     """Build the pair program.  Flat operand order:
     lo, counts, total, perm,
     per probe key: data [remap] [valid],
     per probe col: data [valid],
     per build col: data [valid],
     build key datas.
+
+    Besides the pair outputs the program emits ``overflow`` — a device bool
+    flagging ``total > cap`` (candidates truncated; caller must re-run at a
+    larger bucket).  ``donate`` releases the lo/counts operand buffers into
+    the program (only safe when the caller provably never retries).
 
     ``semi``: None for a regular join; (null_aware, has_null_build,
     build_nonempty) for the semi-join mark variant (outputs (mark, valid)
@@ -469,6 +629,7 @@ def _make_pair_fn(cap: int, num_keys: int, has_pvalid: tuple,
             matched = cnt > 0
             max_per_probe = jnp.max(cnt)
 
+        overflow = jnp.asarray(total, jnp.int64) > cap
         if semi is not None:
             # three-valued NOT IN: a non-match is UNKNOWN (NULL mark) when
             # the probe key is NULL or the build side contains a NULL key;
@@ -485,24 +646,38 @@ def _make_pair_fn(cap: int, num_keys: int, has_pvalid: tuple,
                             null_probe = null_probe | ~v
                     unknown = ~matched & null_probe
                 mark_valid = ~unknown
-            return None, ok, matched, max_per_probe, (matched, mark_valid)
-        return pairs, ok, matched, max_per_probe, build_id
+            return (None, ok, matched, max_per_probe, (matched, mark_valid),
+                    overflow)
+        return pairs, ok, matched, max_per_probe, build_id, overflow
 
+    if donate:
+        return jax.jit(fn, donate_argnums=(0, 1))  # lo, counts
     return jax.jit(fn)
 
 
-def run_pairs(table: DeviceJoinTable, lo, counts, total: int,
+def run_pairs(table: DeviceJoinTable, lo, counts, total,
               probe_keys, remaps, probe_cols, build_cols,
               pair_types, pair_dicts,
               residual: Optional[RowExpression],
-              need_matched: bool, semi: Optional[tuple] = None):
+              need_matched: bool, semi: Optional[tuple] = None,
+              cap: Optional[int] = None, donate: bool = False):
     """Execute the pair program.  Returns (pair_cols|None, pair_live,
-    matched|None, max_per_probe|None, mark|None) — ALL device arrays, zero
-    host syncs.  ``pair_cols`` is [(data, valid|None), ...] over probe cols
-    then build cols, gathered at the matched pairs.  The 5th element is the
-    device build_id per pair slot for a regular join, or the (data, valid)
-    semi-join mark when ``semi`` is set."""
-    cap = K.bucket(max(total, 1))
+    matched|None, max_per_probe|None, mark|None, overflow) — ALL device
+    arrays, zero host syncs.  ``pair_cols`` is [(data, valid|None), ...]
+    over probe cols then build cols, gathered at the matched pairs.  The
+    5th element is the device build_id per pair slot for a regular join, or
+    the (data, valid) semi-join mark when ``semi`` is set.
+
+    ``total`` may be a host int (legacy, picks ``cap`` exactly) or a device
+    scalar (sync-free; ``cap`` must then be given, chosen from build-side
+    statistics — see :class:`ExpandPlanner`).  ``overflow`` is a device bool:
+    True means the ``cap`` bucket truncated candidates and the batch must be
+    re-run at a larger cap (results are otherwise a silent subset).
+    ``donate`` releases lo/counts into the program — only when no retry can
+    follow (the provable-cap path)."""
+    if cap is None:
+        cap = K.bucket(max(int(total), 1))
+    donate = donate and _donate_ok()
     has_pvalid = tuple(v is not None for _, v in probe_keys)
     has_remap = tuple(r is not None for r in remaps)
     pcol_has_valid = tuple(v is not None for _, v in probe_cols)
@@ -512,7 +687,7 @@ def run_pairs(table: DeviceJoinTable, lo, counts, total: int,
                tuple(str(t) for t in pair_types),
                tuple(_dict_token(d) for d in pair_dicts),
                len(probe_cols), len(build_cols), pcol_has_valid,
-               bcol_has_valid, residual, need_matched, semi)
+               bcol_has_valid, residual, need_matched, semi, donate)
         prog = _PAIR_CACHE.pop(key, None)
         if prog is not None:  # re-insert: dict ordering = LRU order
             _PAIR_CACHE[key] = prog
@@ -521,7 +696,7 @@ def run_pairs(table: DeviceJoinTable, lo, counts, total: int,
                              list(pair_types), list(pair_dicts),
                              len(probe_cols), len(build_cols),
                              pcol_has_valid, bcol_has_valid,
-                             residual, need_matched, semi)
+                             residual, need_matched, semi, donate)
         with _PAIR_LOCK:
             prog = _PAIR_CACHE.setdefault(key, prog)
             while len(_PAIR_CACHE) > _PAIR_CACHE_MAX:
@@ -543,9 +718,11 @@ def run_pairs(table: DeviceJoinTable, lo, counts, total: int,
         if v is not None:
             flat.append(jnp.asarray(v))
     flat.extend(table.key_datas)
-    pairs, ok, matched, maxc, extra = prog(
-        lo, counts, jnp.asarray(total, jnp.int64), table.perm, *flat)
-    return pairs, ok, matched, maxc, extra
+    total_dev = (total.value if isinstance(total, SG.AsyncScalar)
+                 else jnp.asarray(total, jnp.int64))
+    pairs, ok, matched, maxc, extra, overflow = prog(
+        lo, counts, total_dev, table.perm, *flat)
+    return pairs, ok, matched, maxc, extra, overflow
 
 
 # ---------------------------------------------------------------------------
@@ -646,6 +823,47 @@ def _dense_uranges_fn(size: int, lo: int, has_pvalid: bool, has_remap: bool,
     return fn
 
 
+def run_unique_ranges_device(table: DeviceJoinTable, probe_keys, remaps,
+                             live=None):
+    """Program A, sync-free: returns (ok_live, bid, count) with the count a
+    SyncGuard AsyncScalar (D2H copy in flight, never blocked on).  The
+    caller must already know the build is unique (``table.unique`` — one
+    scalar fetch per BUILD); probing a duplicate-key build through this
+    entry point silently drops matches."""
+    has_pvalid = tuple(v is not None for _, v in probe_keys)
+    has_remap = tuple(r is not None for r in remaps)
+    if table.dense is not None and len(probe_keys) == 1:
+        d, v = probe_keys[0]
+        flat = [jnp.asarray(d)]
+        if remaps[0] is not None:
+            flat.append(jnp.asarray(remaps[0]))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+        if live is not None:
+            flat.append(jnp.asarray(live))
+        ok, bid, cnt = _dense_uranges_fn(
+            int(table.dense.shape[0]), table.dense_lo,
+            has_pvalid[0], has_remap[0], live is not None)(
+            table.dense, *flat)
+        return ok, bid, SG.async_scalar(cnt, "join.unique-count")
+    flat = []
+    for (d, v), r in zip(probe_keys, remaps):
+        flat.append(jnp.asarray(d))
+        if r is not None:
+            flat.append(jnp.asarray(r))
+        if v is not None:
+            flat.append(jnp.asarray(v))
+    flat.extend(table.key_datas)
+    if live is not None:
+        flat.append(jnp.asarray(live))
+    mr_in = table._scalars[2] if not isinstance(table._scalars, tuple) \
+        else jnp.asarray(table._scalars[2])
+    ok, bid, cnt, _mr = _uranges_fn(
+        len(probe_keys), has_pvalid, has_remap, live is not None)(
+        table.sorted_hash, table.perm, mr_in, *flat)
+    return ok, bid, SG.async_scalar(cnt, "join.unique-count")
+
+
 def run_unique_ranges(table: DeviceJoinTable, probe_keys, remaps, live=None):
     """Program A.  Returns (ok_live, bid, count:int, max_run:int) with ONE
     combined scalar sync; max_run > 1 means the build was not unique and the
@@ -667,7 +885,7 @@ def run_unique_ranges(table: DeviceJoinTable, probe_keys, remaps, live=None):
             int(table.dense.shape[0]), table.dense_lo,
             has_pvalid[0], has_remap[0], live is not None)(
             table.dense, *flat)
-        return ok, bid, int(jax.device_get(cnt)), 1
+        return ok, bid, int(SG.fetch(cnt, "join.unique-count")), 1
     flat = []
     for (d, v), r in zip(probe_keys, remaps):
         flat.append(jnp.asarray(d))
@@ -683,7 +901,7 @@ def run_unique_ranges(table: DeviceJoinTable, probe_keys, remaps, live=None):
     ok, bid, cnt, mr = _uranges_fn(
         len(probe_keys), has_pvalid, has_remap, live is not None)(
         table.sorted_hash, table.perm, mr_in, *flat)
-    cnt_h, mr_h = jax.device_get((cnt, mr))
+    cnt_h, mr_h = SG.fetch((cnt, mr), "join.unique-count+run")
     return ok, bid, int(cnt_h), int(mr_h)
 
 
@@ -718,7 +936,11 @@ def _make_ugather_fn(cap: Optional[int], pair_types, pair_dicts,
                 i += 1
             bcols.append((d, v))
 
+        overflow = None
         if cap is not None:
+            # truncation guard: more matches than compact lanes means the
+            # batch must re-run wide (or at a bigger cap)
+            overflow = jnp.sum(ok_live.astype(jnp.int64)) > cap
             order = jnp.argsort(~ok_live)[:cap]
             ok_c = ok_live[order]
             bid_c = bid[order]
@@ -744,20 +966,32 @@ def _make_ugather_fn(cap: Optional[int], pair_types, pair_dicts,
             build_matched = jnp.zeros((nb,), jnp.bool_).at[bid_c].max(ok_c)
         b_out = [(d, (ok_c if v is None else (v & ok_c)))
                  for d, v in b_out]
-        return tuple(p_out), tuple(b_out), ok_c, build_matched
+        return tuple(p_out), tuple(b_out), ok_c, build_matched, overflow
 
     return jax.jit(fn)
 
 
-def run_unique_gather(table: DeviceJoinTable, ok_live, bid, count: int,
+def plan_unique_cap(n_lanes: int, count: Optional[int]) -> Optional[int]:
+    """Compact-vs-wide decision for program B: compact to bucket(count) when
+    matches fill < 1/4 of the lanes, else stay wide (None).  ``count`` may be
+    an exact host int (legacy) or an estimate from a previous batch's
+    asynchronously-landed count (sync-free; overflow flag guards it)."""
+    if count is None:
+        return None
+    return K.bucket(max(count, 1)) if count * 4 <= n_lanes else None
+
+
+def run_unique_gather(table: DeviceJoinTable, ok_live, bid,
+                      cap: Optional[int],
                       probe_cols, build_cols, pair_types, pair_dicts,
                       residual: Optional[RowExpression],
                       need_build_matched: bool):
-    """Program B dispatch: compact when matches are sparse (<1/4 of lanes),
-    wide otherwise.  Returns (probe_out|None, build_out, live, build_matched)
-    — probe_out is None on the wide path (original columns pass through)."""
-    n_lanes = int(ok_live.shape[0])
-    cap = K.bucket(max(count, 1)) if count * 4 <= n_lanes else None
+    """Program B dispatch at a planner-chosen ``cap`` (None = wide).
+    Returns (probe_out|None, build_out, live, build_matched, overflow) —
+    probe_out is None on the wide path (original columns pass through);
+    ``overflow`` is a device bool on the compact path (True = cap truncated
+    matches, caller must re-run wide or bigger) and None on the wide path,
+    which cannot overflow."""
     if cap is None and residual is None:
         # wide + residual-free: probe columns pass through OUTSIDE the
         # program (feeding them through a jit identity would copy them)
@@ -790,8 +1024,8 @@ def run_unique_gather(table: DeviceJoinTable, ok_live, bid, count: int,
         flat.append(jnp.asarray(d))
         if v is not None:
             flat.append(jnp.asarray(v))
-    p_out, b_out, live, bm = prog(ok_live, bid, *flat)
-    return (None if cap is None else p_out), b_out, live, bm
+    p_out, b_out, live, bm, overflow = prog(ok_live, bid, *flat)
+    return (None if cap is None else p_out), b_out, live, bm, overflow
 
 
 # ---------------------------------------------------------------------------

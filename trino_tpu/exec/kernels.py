@@ -22,6 +22,7 @@ spec / Trino GroupByHash behavior); equi-join keys never match on NULL.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import Optional, Sequence
 
@@ -51,15 +52,25 @@ def bucket(n: int, minimum: int = 8) -> int:
     return c
 
 
+@lru_cache(maxsize=None)
+def _searchsorted_method(shape: tuple) -> str:
+    n_needles = 1
+    for s in shape:
+        n_needles *= int(s)
+    return "sort" if n_needles >= 4096 else "scan"
+
+
 def searchsorted(a, v, side: str = "left"):
     """TPU-aware searchsorted: the default 'scan' method is a serial
     binary search — log(n) dependent HBM gathers PER NEEDLE — measured at
     ~1s for 2M needles on v5e, while the 'sort' method (sort the concat,
     derive positions) rides the optimized XLA bitonic sort at ~1ms.  Small
     needle counts keep 'scan' (sorting the haystack for 8 needles wastes a
-    full pass)."""
-    n_needles = int(np.prod(v.shape)) if hasattr(v, "shape") else 1
-    method = "sort" if n_needles >= 4096 else "scan"
+    full pass).  The method pick is memoized per needle SHAPE: this runs on
+    every trace of every jitted program, so the per-call product over the
+    dims is hoisted into an lru_cache keyed like the jit cache itself."""
+    method = (_searchsorted_method(tuple(v.shape))
+              if hasattr(v, "shape") else "scan")
     return jnp.searchsorted(a, v, side=side, method=method)
 
 
@@ -1162,14 +1173,25 @@ def _sorted_hash(h):
 class JoinTable:
     """Sorted-hash build side (the PagesHash/LookupSource equivalent)."""
 
-    __slots__ = ("sorted_hash", "perm", "key_datas", "has_null_key", "num_rows")
+    __slots__ = ("sorted_hash", "perm", "key_datas", "_has_null", "num_rows")
 
     def __init__(self, sorted_hash, perm, key_datas, has_null_key, num_rows):
         self.sorted_hash = sorted_hash
         self.perm = perm  # build row index per sorted-hash position
         self.key_datas = key_datas  # original (unsorted) key arrays for verify
-        self.has_null_key = has_null_key
+        # host bool, or a device scalar fetched lazily on first access (its
+        # async copy usually lands before any probe asks)
+        self._has_null = has_null_key
         self.num_rows = num_rows
+
+    @property
+    def has_null_key(self) -> bool:
+        if not isinstance(self._has_null, bool):
+            from . import syncguard as SG
+
+            self._has_null = bool(
+                SG.fetch(self._has_null, "kernels.has-null-key"))
+        return self._has_null
 
 
 def build_join_table(keys: Sequence[tuple], num_rows: Optional[int] = None) -> JoinTable:
@@ -1191,7 +1213,14 @@ def build_join_table(keys: Sequence[tuple], num_rows: Optional[int] = None) -> J
             null_mask = nm if null_mask is None else (null_mask | nm)
     has_null = False
     if null_mask is not None:
-        has_null = bool(np.asarray(jnp.any(null_mask)))
+        # stays a device scalar: building the table costs zero blocking
+        # syncs; JoinTable.has_null_key fetches lazily (async copy already
+        # in flight, usually landed by first access)
+        has_null = jnp.any(null_mask)
+        try:
+            has_null.copy_to_host_async()
+        except AttributeError:
+            pass
         # reserved sentinel: max uint64 never produced for probes (probes with
         # null keys are masked out before lookup)
         h = jnp.where(null_mask, jnp.uint64(0xFFFFFFFFFFFFFFFF), h)
@@ -1208,6 +1237,9 @@ def _probe_ranges_fn():
         return lo, hi - lo
 
     return fn
+
+
+_PAIR_PAD = 4  # speculative expand headroom over bucket(n_probe)
 
 
 @lru_cache(maxsize=None)
@@ -1265,19 +1297,48 @@ def probe_join_table(
     if table.has_null_key:
         # sentinel region must never match
         counts = jnp.where(ph == jnp.uint64(0xFFFFFFFFFFFFFFFF), 0, counts)
-    total = int(np.asarray(jnp.sum(counts)))
-    if total == 0:
-        return np.empty(0, np.int64), np.empty(0, np.int64)
-    probe_id, build_id = _expand_fn(bucket(total))(lo, counts, table.perm)
-    probe_id, build_id = probe_id[:total], build_id[:total]
-    # exact verification (hash candidates -> equality on every key column);
-    # float equality mirrors the grouping semantics: NaN matches NaN
-    ok = jnp.ones((total,), jnp.bool_)
-    for (pd, pv), bd in zip(probe_keys, table.key_datas):
-        p, b = jnp.asarray(pd)[probe_id], bd[build_id]
-        ok = ok & ~_neq(p, b)
-    # one device->host round trip for all three arrays (not three)
-    keep, probe_id, build_id = jax.device_get((ok, probe_id, build_id))
+    from . import syncguard as SG
+
+    total_dev = jnp.sum(counts)
+    if os.environ.get("TRINO_TPU_LEGACY_EXPAND") == "1":
+        # legacy two-fetch expand: block on the exact candidate total, size
+        # the bucket from it, then fetch the verified pairs (kept for
+        # equivalence testing against the padded single-fetch path)
+        total = int(SG.fetch(total_dev, "kernels.pair-total"))
+        if total == 0:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        probe_id, build_id = _expand_fn(bucket(total))(lo, counts, table.perm)
+        probe_id, build_id = probe_id[:total], build_id[:total]
+        ok = jnp.ones((total,), jnp.bool_)
+        for (pd, pv), bd in zip(probe_keys, table.key_datas):
+            p, b = jnp.asarray(pd)[probe_id], bd[build_id]
+            ok = ok & ~_neq(p, b)
+        keep, probe_id, build_id = SG.fetch(
+            (ok, probe_id, build_id), "kernels.pair-batch")
+        return probe_id[keep], build_id[keep]
+
+    def expand_verify(cap: int):
+        """Padded expand + exact verify (hash candidates -> equality on
+        every key column; float equality mirrors the grouping semantics:
+        NaN matches NaN).  Slots beyond the total are masked out."""
+        probe_id, build_id = _expand_fn(cap)(lo, counts, table.perm)
+        ok = jnp.arange(cap) < total_dev
+        for (pd, pv), bd in zip(probe_keys, table.key_datas):
+            p, b = jnp.asarray(pd)[probe_id], bd[build_id]
+            ok = ok & ~_neq(p, b)
+        return ok, probe_id, build_id
+
+    # padded single-fetch expand: speculate a bucket from the probe width,
+    # land the total WITH the verified pairs in one device->host round trip
+    # (the blocking total-sync this replaces was half the legacy path's RTTs)
+    cap = bucket(max(n_probe, 1)) * _PAIR_PAD
+    total, keep, probe_id, build_id = SG.fetch(
+        (total_dev,) + expand_verify(cap), "kernels.pair-batch")
+    if int(total) > cap:  # rare: speculation too small — exact-size re-run
+        SG.count_overflow()
+        total, keep, probe_id, build_id = SG.fetch(
+            (total_dev,) + expand_verify(bucket(int(total))),
+            "kernels.pair-batch")
     return probe_id[keep], build_id[keep]
 
 

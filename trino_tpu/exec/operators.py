@@ -20,6 +20,7 @@ moves fixed-shape column arrays in and out of those programs.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional, Sequence
 
@@ -35,6 +36,7 @@ from ..spi.types import BIGINT, BOOLEAN, DOUBLE, DecimalType, Type, is_string
 from ..sql.ir import RowExpression
 from ..planner.plan import AggCall, SortKey, WindowFunc
 from . import kernels as K
+from . import syncguard as SG
 from . import window_kernels as WK
 from .prefetch import (
     BatchCoalescer,
@@ -604,6 +606,15 @@ _COMPACT_FACTOR = 4  # compact when live rows < lanes/4
 _COMPACT_MIN_LANES = 1 << 16  # below this a count sync costs more than it saves
 
 
+def _sync_free() -> bool:
+    """Sync-free probe/expand hot loop (default on): joins pick padded
+    expand capacities from build-side statistics and defer overflow checks
+    to async flag polls, so steady-state probe batches cross the device
+    boundary zero times.  ``TRINO_TPU_SYNC_FREE=0`` restores the legacy
+    one-scalar-sync-per-batch paths (equivalence tests, triage)."""
+    return os.environ.get("TRINO_TPU_SYNC_FREE", "1") != "0"
+
+
 def _maybe_compact_device(batch: ColumnBatch) -> ColumnBatch:
     """Shrink a sparsely-live device batch to bucket(live) lanes before
     O(lanes log lanes) work.  A selective join keeps its probe batch's fat
@@ -616,7 +627,7 @@ def _maybe_compact_device(batch: ColumnBatch) -> ColumnBatch:
     n = batch.num_rows
     if n < _COMPACT_MIN_LANES:
         return batch
-    count = int(np.asarray(jnp.sum(jnp.asarray(live))))
+    count = int(SG.fetch(jnp.sum(jnp.asarray(live)), "exec.compact-count"))
     if count * _COMPACT_FACTOR <= n:
         return K.compact_device_batch(batch, count)
     return batch
@@ -1158,8 +1169,9 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                 limbs = reduced[ri:ri + 6]
                 cnt_res = reduced[ri + 7 - 1]
                 ri += 7
-                pulled = jax.device_get(
-                    [d for d, _ in limbs] + [cnt_res[0]])
+                pulled = SG.fetch(
+                    [d for d, _ in limbs] + [cnt_res[0]],
+                    "agg.decimal-limbs")
                 counts = np.asarray(pulled[-1])
                 src_scale = 0
                 if a.arg >= 0:
@@ -1437,7 +1449,8 @@ def _nested_loop_pairs(probe: ColumnBatch, build: ColumnBatch,
     prog = _residual_program(
         residual, [c.type for c in pair.columns],
         [c.dictionary for c in pair.columns])
-    mask = np.asarray(jax.device_get(prog(_to_cols(pair))))[:n]
+    mask = np.asarray(
+        SG.fetch(prog(_to_cols(pair)), "join.nested-loop-residual"))[:n]
     return pi[mask], bi[mask]
 
 
@@ -1463,11 +1476,20 @@ class LookupJoinOperator(Operator):
         self.output_types = list(output_types)
         from collections import deque
 
+        from . import join_exec as JX
+
         self._pending: "deque[ColumnBatch]" = deque()
         self._build_matched = None  # device bool per build slot (RIGHT/FULL)
         self._emitted_unmatched = False
         # probe-side dictionaries observed, for null-extended unmatched rows
         self._probe_dicts: Optional[list] = None
+        # sync-free expand state: capacity planners fed by async-landed
+        # totals, and the deferred-commit queue for estimated-cap batches
+        # whose overflow flag is still in flight (exec/join_exec.py)
+        self._planner = JX.ExpandPlanner()
+        self._uplanner = JX.ExpandPlanner()
+        self._inflight = JX.OverflowQueue()
+        self.pending_errors: list = []  # deferred cardinality violations
 
     def needs_input(self) -> bool:
         return self.bridge.ready and not self._pending and super().needs_input()
@@ -1531,28 +1553,61 @@ class LookupJoinOperator(Operator):
                 # path with zero per-batch syncs
                 self._add_unique_input(probe, table, build, keys, remaps)
                 return
-        lo, counts, total = JX.probe_ranges(table, keys, remaps, probe.live)
+        self._add_pairs(probe, table, build, keys, remaps)
+
+    def _null_extended(self, probe: ColumnBatch, build: ColumnBatch,
+                       un_live) -> ColumnBatch:
+        """Unmatched probe rows ride the ORIGINAL probe batch shape with a
+        live mask (no gather, no compaction): probe columns pass through,
+        build columns are all-NULL."""
+        n = probe.num_rows
+        right_cols = [
+            Column(c.type, jnp.zeros(n, c.type.storage_dtype),
+                   jnp.zeros(n, jnp.bool_), c.dictionary)
+            for c in build.columns
+        ]
+        return ColumnBatch(
+            self.output_names, list(probe.columns) + right_cols, un_live)
+
+    def _add_pairs(self, probe: ColumnBatch, table, build,
+                   keys, remaps) -> None:
+        """General (non-unique build) probe: candidate ranges + padded
+        expand.  Sync-free mode picks the expand bucket from build-side
+        statistics (ExpandPlanner) so the steady state never blocks on the
+        candidate total; TRINO_TPU_SYNC_FREE=0 keeps the legacy
+        one-total-sync-per-batch behavior."""
+        from . import join_exec as JX
+
         need_matched = self.join_type in ("LEFT", "SINGLE", "FULL")
         if self.join_type in ("RIGHT", "FULL"):
             self._probe_dicts = [c.dictionary for c in probe.columns]
+        if probe.num_rows == 0:
+            return
+        if table.num_rows == 0:  # empty build: no pairs, all probes unmatched
+            if need_matched:
+                self._pending.append(
+                    self._null_extended(probe, build, probe.live))
+            return
+        probe_cols = [(c.data, c.valid) for c in probe.columns]
+        build_cols = [(c.data, c.valid) for c in build.columns]
+        pair_types = ([c.type for c in probe.columns]
+                      + [c.type for c in build.columns])
+        pair_dicts = ([c.dictionary for c in probe.columns]
+                      + [c.dictionary for c in build.columns])
+        sf = _sync_free()
 
-        matched = None
-        if total:
-            probe_cols = [(c.data, c.valid) for c in probe.columns]
-            build_cols = [(c.data, c.valid) for c in build.columns]
-            pair_types = ([c.type for c in probe.columns]
-                          + [c.type for c in build.columns])
-            pair_dicts = ([c.dictionary for c in probe.columns]
-                          + [c.dictionary for c in build.columns])
-            pairs, ok, matched, maxc, build_id = JX.run_pairs(
-                table, lo, counts, total, keys, remaps, probe_cols,
-                build_cols, pair_types, pair_dicts, self.residual,
-                need_matched)
-            if self.join_type == "SINGLE":
+        def commit(res) -> None:
+            pairs, ok, matched, maxc, build_id, _overflow = res
+            if self.join_type == "SINGLE" and sf:
                 # scalar subquery: >1 match per probe row is a cardinality
-                # violation (EnforceSingleRowNode semantics)
-                if int(maxc) > 1:
-                    raise RuntimeError("scalar subquery returned multiple rows")
+                # violation (EnforceSingleRowNode semantics).  The check
+                # stays a device scalar on the deferred error channel —
+                # raised by check_error_scalars at pipeline end, costing
+                # zero extra syncs here (ops/expr.py)
+                from ..ops.expr import SUBQUERY_MULTIPLE_ROWS
+
+                self.pending_errors.append(jnp.where(
+                    jnp.asarray(maxc) > 1, SUBQUERY_MULTIPLE_ROWS, 0))
             if self.join_type in ("RIGHT", "FULL"):
                 if self._build_matched is None:
                     self._build_matched = jnp.zeros(build.num_rows, jnp.bool_)
@@ -1562,24 +1617,58 @@ class LookupJoinOperator(Operator):
                         zip(pairs, pair_types, pair_dicts)]
             self._pending.append(
                 ColumnBatch(self.output_names, out_cols, ok))
-
-        if need_matched:
-            # unmatched probe rows ride the ORIGINAL probe batch shape with
-            # a live mask (no gather, no compaction): probe columns pass
-            # through, build columns are all-NULL
-            if matched is None:
-                un_live = probe.live  # nothing matched: all live rows
-            else:
+            if need_matched:
                 un_live = ~matched if probe.live is None else (
                     jnp.asarray(probe.live) & ~matched)
-            n = probe.num_rows
-            right_cols = [
-                Column(c.type, jnp.zeros(n, c.type.storage_dtype),
-                       jnp.zeros(n, jnp.bool_), c.dictionary)
-                for c in build.columns
-            ]
-            self._pending.append(ColumnBatch(
-                self.output_names, list(probe.columns) + right_cols, un_live))
+                self._pending.append(
+                    self._null_extended(probe, build, un_live))
+
+        if not sf:
+            # legacy: ONE blocking candidate-total sync picks the bucket
+            lo, counts, total = JX.probe_ranges(
+                table, keys, remaps, probe.live)
+            if not total:
+                if need_matched:  # nothing matched: all live rows pass
+                    self._pending.append(
+                        self._null_extended(probe, build, probe.live))
+                return
+            res = JX.run_pairs(
+                table, lo, counts, total, keys, remaps, probe_cols,
+                build_cols, pair_types, pair_dicts, self.residual,
+                need_matched)
+            if self.join_type == "SINGLE" and int(
+                    SG.fetch(res[3], "join.single-maxc")) > 1:
+                raise RuntimeError("scalar subquery returned multiple rows")
+            commit(res)
+            return
+
+        with SG.hot_region():
+            lo, counts, total_a = JX.probe_ranges_device(
+                table, keys, remaps, probe.live)
+            cap, provable = self._planner.plan(probe.num_rows, table.max_run)
+            self._planner.observe_async(total_a)
+            res = JX.run_pairs(
+                table, lo, counts, total_a, keys, remaps, probe_cols,
+                build_cols, pair_types, pair_dicts, self.residual,
+                need_matched, cap=cap, donate=provable)
+            if provable:  # cap >= any possible total: no overflow, no retry
+                commit(res)
+                return
+
+            def retry():
+                # rare: the estimated bucket truncated candidates — re-run
+                # at the exact total (landed long ago by drain time)
+                total_h = max(int(total_a.get()), 1)
+                self._planner.observe(total_h)
+                return JX.run_pairs(
+                    table, lo, counts, total_h, keys, remaps, probe_cols,
+                    build_cols, pair_types, pair_dicts, self.residual,
+                    need_matched)
+
+            self._inflight.push(
+                SG.async_scalar(res[5], "join.expand-overflow"),
+                res, retry, commit)
+            self._inflight.drain()
 
     def _add_inner_unique(self, probe: ColumnBatch, table, build,
                           keys, remaps) -> bool:
@@ -1588,13 +1677,25 @@ class LookupJoinOperator(Operator):
         falls back to the general pair path."""
         from . import join_exec as JX
 
-        ok_live, bid, cnt, mr = JX.run_unique_ranges(
-            table, keys, remaps, probe.live)
-        if mr > 1:
-            return False
+        sf = _sync_free()
+        if sf:
+            # uniqueness comes from the per-BUILD scalar fetch (amortized
+            # over every probe batch); ranges + count stay on device
+            if not table.unique:
+                return False
+            if probe.num_rows == 0:
+                return True
+            ok_live, bid, cnt_a = JX.run_unique_ranges_device(
+                table, keys, remaps, probe.live)
+            cnt = None
+        else:
+            ok_live, bid, cnt, mr = JX.run_unique_ranges(
+                table, keys, remaps, probe.live)
+            if mr > 1:
+                return False
         if self.join_type == "RIGHT":
             self._probe_dicts = [c.dictionary for c in probe.columns]
-        if cnt == 0:
+        if cnt == 0:  # legacy only (sync-free never knows the exact count)
             return True  # nothing matched; RIGHT epilogue emits build rows
         probe_cols = [(c.data, c.valid) for c in probe.columns]
         build_cols = [(c.data, c.valid) for c in build.columns]
@@ -1603,23 +1704,57 @@ class LookupJoinOperator(Operator):
         pair_dicts = ([c.dictionary for c in probe.columns]
                       + [c.dictionary for c in build.columns])
         need_bm = self.join_type == "RIGHT"
-        p_out, b_out, live, bm = JX.run_unique_gather(
-            table, ok_live, bid, cnt, probe_cols, build_cols,
-            pair_types, pair_dicts, self.residual, need_bm)
-        if need_bm and bm is not None:
-            if self._build_matched is None:
-                self._build_matched = bm
+
+        def commit(res) -> None:
+            p_out, b_out, live, bm, _overflow = res
+            if need_bm and bm is not None:
+                if self._build_matched is None:
+                    self._build_matched = bm
+                else:
+                    self._build_matched = jnp.asarray(
+                        self._build_matched) | bm
+            if p_out is None:  # wide: probe columns pass through untouched
+                left_cols = list(probe.columns)
             else:
-                self._build_matched = jnp.asarray(self._build_matched) | bm
-        if p_out is None:  # wide: probe columns pass through untouched
-            left_cols = list(probe.columns)
-        else:
-            left_cols = [Column(c.type, d, v, c.dictionary)
-                         for c, (d, v) in zip(probe.columns, p_out)]
-        right_cols = [Column(c.type, d, v, c.dictionary)
-                      for c, (d, v) in zip(build.columns, b_out)]
-        self._pending.append(ColumnBatch(
-            self.output_names, left_cols + right_cols, live))
+                left_cols = [Column(c.type, d, v, c.dictionary)
+                             for c, (d, v) in zip(probe.columns, p_out)]
+            right_cols = [Column(c.type, d, v, c.dictionary)
+                          for c, (d, v) in zip(build.columns, b_out)]
+            self._pending.append(ColumnBatch(
+                self.output_names, left_cols + right_cols, live))
+
+        if not sf:
+            cap = JX.plan_unique_cap(probe.num_rows, cnt)
+            commit(JX.run_unique_gather(
+                table, ok_live, bid, cap, probe_cols, build_cols,
+                pair_types, pair_dicts, self.residual, need_bm))
+            return True
+
+        with SG.hot_region():
+            # compact-vs-wide from the previous batches' async-landed match
+            # counts; the compact path's overflow flag guards the estimate
+            est = self._uplanner.recent_max()
+            cap = JX.plan_unique_cap(
+                probe.num_rows,
+                None if est is None else est * JX.EST_HEADROOM)
+            self._uplanner.observe_async(cnt_a)
+            res = JX.run_unique_gather(
+                table, ok_live, bid, cap, probe_cols, build_cols,
+                pair_types, pair_dicts, self.residual, need_bm)
+            if cap is None:  # wide path cannot overflow
+                commit(res)
+                return True
+
+            def retry():
+                # compact bucket overflowed: re-run wide (provably safe)
+                return JX.run_unique_gather(
+                    table, ok_live, bid, None, probe_cols, build_cols,
+                    pair_types, pair_dicts, self.residual, need_bm)
+
+            self._inflight.push(
+                SG.async_scalar(res[4], "join.unique-overflow"),
+                res, retry, commit)
+            self._inflight.drain()
         return True
 
     def _add_unique_input(self, probe: ColumnBatch, table, build,
@@ -1643,10 +1778,11 @@ class LookupJoinOperator(Operator):
         else:
             pair_types, pair_dicts = [], []
         need_bm = self.join_type in ("RIGHT", "FULL")
-        bgather, ok_live, build_matched, _ = JX.run_unique(
-            table, keys, remaps, probe_cols, build_cols,
-            pair_types, pair_dicts, self.residual, need_bm,
-            live=probe.live)
+        with SG.hot_region():
+            bgather, ok_live, build_matched, _ = JX.run_unique(
+                table, keys, remaps, probe_cols, build_cols,
+                pair_types, pair_dicts, self.residual, need_bm,
+                live=probe.live)
         if need_bm:
             self._probe_dicts = [c.dictionary for c in probe.columns]
             if self._build_matched is None:
@@ -1693,6 +1829,10 @@ class LookupJoinOperator(Operator):
         return ColumnBatch(self.output_names, left_cols + right_cols)
 
     def get_output(self) -> Optional[ColumnBatch]:
+        if len(self._inflight):
+            # commit landed estimated-cap batches; at input end the tail
+            # entries are waited on (the only blocking poll of the query)
+            self._inflight.drain(block=self.input_done)
         if self._pending:
             return self._pending.popleft()
         if (self.input_done and not self._closed
@@ -1705,7 +1845,8 @@ class LookupJoinOperator(Operator):
     def is_finished(self) -> bool:
         if self._closed:
             return True
-        done = self.input_done and not self._pending
+        done = (self.input_done and not self._pending
+                and not len(self._inflight))
         if self.join_type in ("RIGHT", "FULL"):
             return done and self._emitted_unmatched
         return done
@@ -1727,10 +1868,16 @@ class SemiJoinOperator(Operator):
         self.residual = residual
         self.output_names = list(output_names)
         self.output_types = list(output_types)
-        self._pending: Optional[ColumnBatch] = None
+        from collections import deque
+
+        from . import join_exec as JX
+
+        self._pending: "deque[ColumnBatch]" = deque()
+        self._planner = JX.ExpandPlanner()
+        self._inflight = JX.OverflowQueue()
 
     def needs_input(self) -> bool:
-        return self.bridge.ready and self._pending is None and super().needs_input()
+        return self.bridge.ready and not self._pending and super().needs_input()
 
     def _add_keyless_input(self, batch: ColumnBatch) -> None:
         """EXISTS with only non-equi residuals decorrelates to a keyless
@@ -1742,8 +1889,8 @@ class SemiJoinOperator(Operator):
         matched = np.zeros(batch.num_rows, bool)
         matched[pi] = True
         mark = Column(BOOLEAN, matched, None)
-        self._pending = ColumnBatch(
-            self.output_names, list(batch.columns) + [mark], batch.live)
+        self._pending.append(ColumnBatch(
+            self.output_names, list(batch.columns) + [mark], batch.live))
 
     def add_input(self, batch: ColumnBatch) -> None:
         from . import join_exec as JX
@@ -1756,8 +1903,13 @@ class SemiJoinOperator(Operator):
         if table.num_rows == 0:
             # IN over the empty set is FALSE (never UNKNOWN)
             mark = Column(BOOLEAN, np.zeros(batch.num_rows, bool), None)
-            self._pending = ColumnBatch(
-                self.output_names, list(batch.columns) + [mark], batch.live)
+            self._pending.append(ColumnBatch(
+                self.output_names, list(batch.columns) + [mark], batch.live))
+            return
+        if batch.num_rows == 0:
+            mark = Column(BOOLEAN, np.zeros(0, bool), None)
+            self._pending.append(ColumnBatch(
+                self.output_names, list(batch.columns) + [mark], batch.live))
             return
         keys = []
         remaps = []
@@ -1779,16 +1931,16 @@ class SemiJoinOperator(Operator):
                               + [c.dictionary for c in build.columns])
             else:
                 probe_cols, build_cols, pair_types, pair_dicts = [], [], [], []
-            _, _, _, mark_out = JX.run_unique(
-                table, keys, remaps, probe_cols, build_cols,
-                pair_types, pair_dicts, self.residual, False, semi=semi,
-                live=batch.live)
+            with SG.hot_region():
+                _, _, _, mark_out = JX.run_unique(
+                    table, keys, remaps, probe_cols, build_cols,
+                    pair_types, pair_dicts, self.residual, False, semi=semi,
+                    live=batch.live)
             mark_data, mark_valid = mark_out
             mark = Column(BOOLEAN, mark_data, mark_valid)
-            self._pending = ColumnBatch(
-                self.output_names, list(batch.columns) + [mark], batch.live)
+            self._pending.append(ColumnBatch(
+                self.output_names, list(batch.columns) + [mark], batch.live))
             return
-        lo, counts, total = JX.probe_ranges(table, keys, remaps, batch.live)
         if self.residual is not None:
             probe_cols = [(c.data, c.valid) for c in batch.columns]
             build_cols = [(c.data, c.valid) for c in build.columns]
@@ -1798,20 +1950,56 @@ class SemiJoinOperator(Operator):
                           + [c.dictionary for c in build.columns])
         else:
             probe_cols, build_cols, pair_types, pair_dicts = [], [], [], []
-        _, _, _, _, mark_out = JX.run_pairs(
-            table, lo, counts, total, keys, remaps, probe_cols, build_cols,
-            pair_types, pair_dicts, self.residual, False, semi=semi)
-        mark_data, mark_valid = mark_out
-        mark = Column(BOOLEAN, mark_data, mark_valid)
-        self._pending = ColumnBatch(
-            self.output_names, list(batch.columns) + [mark], batch.live)
+
+        def commit(res) -> None:
+            mark_data, mark_valid = res[4]
+            mark = Column(BOOLEAN, mark_data, mark_valid)
+            self._pending.append(ColumnBatch(
+                self.output_names, list(batch.columns) + [mark], batch.live))
+
+        if not _sync_free():
+            lo, counts, total = JX.probe_ranges(
+                table, keys, remaps, batch.live)
+            commit(JX.run_pairs(
+                table, lo, counts, total, keys, remaps, probe_cols,
+                build_cols, pair_types, pair_dicts, self.residual, False,
+                semi=semi))
+            return
+
+        with SG.hot_region():
+            lo, counts, total_a = JX.probe_ranges_device(
+                table, keys, remaps, batch.live)
+            cap, provable = self._planner.plan(batch.num_rows, table.max_run)
+            self._planner.observe_async(total_a)
+            res = JX.run_pairs(
+                table, lo, counts, total_a, keys, remaps, probe_cols,
+                build_cols, pair_types, pair_dicts, self.residual, False,
+                semi=semi, cap=cap, donate=provable)
+            if provable:
+                commit(res)
+                return
+
+            def retry():
+                total_h = max(int(total_a.get()), 1)
+                self._planner.observe(total_h)
+                return JX.run_pairs(
+                    table, lo, counts, total_h, keys, remaps, probe_cols,
+                    build_cols, pair_types, pair_dicts, self.residual,
+                    False, semi=semi)
+
+            self._inflight.push(
+                SG.async_scalar(res[5], "join.expand-overflow"),
+                res, retry, commit)
+            self._inflight.drain()
 
     def get_output(self) -> Optional[ColumnBatch]:
-        b, self._pending = self._pending, None
-        return b
+        if len(self._inflight):
+            self._inflight.drain(block=self.input_done)
+        return self._pending.popleft() if self._pending else None
 
     def is_finished(self) -> bool:
-        return self.input_done and self._pending is None
+        return (self.input_done and not self._pending
+                and not len(self._inflight))
 
 
 # ---------------------------------------------------------------------------

@@ -73,10 +73,28 @@ class IngestConfig:
     stage_device: bool = True       # TRINO_TPU_STAGE_DEVICE
 
     @staticmethod
+    def default_threads() -> int:
+        """Prefetch decode threads auto-tuned from the host: cpu_count - 1
+        (one core stays with the consumer/dispatch thread), capped at 4 —
+        the decode is memory-bandwidth-bound past that.  A single-core host
+        gets 0: an extra thread there only adds GIL contention, so the scan
+        runs synchronously instead."""
+        return min(4, max(0, (os.cpu_count() or 1) - 1))
+
+    @staticmethod
     def from_env() -> "IngestConfig":
+        threads = _env_int("TRINO_TPU_PREFETCH_THREADS", -1)
+        explicit_on = os.environ.get("TRINO_TPU_PREFETCH") == "1"
+        if threads < 0:  # unset: auto-tune from the host core count
+            threads = IngestConfig.default_threads()
+            if explicit_on:  # explicit opt-in overrides the auto-disable
+                threads = max(1, threads)
         return IngestConfig(
-            enabled=os.environ.get("TRINO_TPU_PREFETCH", "1") != "0",
-            threads=max(1, _env_int("TRINO_TPU_PREFETCH_THREADS", 2)),
+            # threads == 0 (explicit, or auto on single-core) disables the
+            # async path entirely rather than spawning useless workers
+            enabled=(os.environ.get("TRINO_TPU_PREFETCH", "1") != "0"
+                     and threads > 0),
+            threads=max(1, threads),
             queue_depth=max(1, _env_int("TRINO_TPU_PREFETCH_QUEUE_DEPTH", 8)),
             queue_bytes=max(1, _env_int(
                 "TRINO_TPU_PREFETCH_QUEUE_BYTES", 256 << 20)),

@@ -120,11 +120,19 @@ class QueryStats:
     label: str = ""
     pipelines: list[PipelineStats] = field(default_factory=list)
     scan: ScanIngestStats | None = None
+    sync: "object | None" = None  # syncguard.SyncStats delta for this query
 
     def merge_scan(self, ingest: ScanIngestStats) -> None:
         if self.scan is None:
             self.scan = ScanIngestStats()
         self.scan.merge(ingest)
+
+    def merge_sync(self, sync) -> None:
+        if self.sync is None:
+            from .syncguard import SyncStats
+
+            self.sync = SyncStats()
+        self.sync.merge(sync)
 
     def text(self) -> str:
         lines = []
@@ -132,6 +140,8 @@ class QueryStats:
             lines.append(self.label)
         if self.scan is not None and self.scan.scan_batches:
             lines.append("  " + self.scan.text())
+        if self.sync is not None and self.sync.host_syncs:
+            lines.append("  " + self.sync.text())
         for i, p in enumerate(self.pipelines):
             lines.append(f"  pipeline {i}:")
             for op in p.operators:

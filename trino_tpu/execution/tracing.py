@@ -14,7 +14,22 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-__all__ = ["Span", "Tracer", "annotate_scan_span"]
+__all__ = ["Span", "Tracer", "annotate_scan_span", "annotate_sync_span"]
+
+
+def annotate_sync_span(span: "Span", sync) -> None:
+    """Set the ``trino.exec.*`` host-transfer attributes from a SyncGuard
+    SyncStats delta (exec/syncguard.py), so exporters see how many times the
+    operator hot path crossed the device boundary next to the wall time."""
+    if sync is None or not sync.host_syncs:
+        return
+    span.set("trino.exec.host-syncs", sync.host_syncs)
+    span.set("trino.exec.blocking-syncs", sync.blocking_syncs)
+    span.set("trino.exec.hot-loop-syncs", sync.hot_loop_syncs)
+    span.set("trino.exec.async-polls", sync.async_polls)
+    span.set("trino.exec.async-poll-hits", sync.poll_hits)
+    span.set("trino.exec.expand-overflows", sync.expand_overflows)
+    span.set("trino.exec.expand-retries", sync.expand_retries)
 
 
 def annotate_scan_span(span: "Span", ingest) -> None:

@@ -85,10 +85,13 @@ def _all_valids(vs):
 DIVISION_BY_ZERO = 1
 NUMERIC_OUT_OF_RANGE = 2
 INVALID_CAST = 3
+SUBQUERY_MULTIPLE_ROWS = 4
 ERROR_NAMES = {
     DIVISION_BY_ZERO: "DIVISION_BY_ZERO: division by zero",
     NUMERIC_OUT_OF_RANGE: "NUMERIC_VALUE_OUT_OF_RANGE: value out of range",
     INVALID_CAST: "INVALID_CAST_ARGUMENT: invalid cast",
+    SUBQUERY_MULTIPLE_ROWS:
+        "SUBQUERY_MULTIPLE_ROWS: scalar subquery returned multiple rows",
 }
 
 
@@ -160,9 +163,9 @@ def check_error_scalars(scalars) -> None:
     """One batched device fetch; raises QueryError on the worst code."""
     if not scalars:
         return
-    import jax
+    from ..exec import syncguard as SG
 
-    codes = [int(c) for c in jax.device_get(list(scalars))]
+    codes = [int(c) for c in SG.fetch(list(scalars), "exec.error-scalars")]
     worst = max(codes)
     if worst:
         raise QueryError(worst)

@@ -17,7 +17,7 @@ from .connectors.catalog import Catalog, default_catalog
 from .exec.driver import collect_scan_stats, run_pipelines
 from .exec.local_planner import LocalPlanner
 from .exec.stats import QueryStats
-from .execution.tracing import annotate_scan_span
+from .execution.tracing import annotate_scan_span, annotate_sync_span
 from .planner.logical import LogicalPlanner
 from .planner.optimizer import optimize
 from .planner.plan import PlanNode, plan_text
@@ -478,9 +478,13 @@ class StandaloneQueryRunner:
             task_concurrency=self.session.task_concurrency,
         ).plan(plan)
         stats = QueryStats() if collect_stats else None
+        from .exec import syncguard
+
+        sync_before = syncguard.snapshot()
         with self.tracer.span("trino.execution") as sp:
             run_pipelines(local.pipelines, stats)
             annotate_scan_span(sp, collect_scan_stats(local.pipelines))
+            annotate_sync_span(sp, syncguard.take_delta(sync_before))
         batches = local.collector.batches
         if batches:
             batch = ColumnBatch.concat(batches)

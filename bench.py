@@ -22,6 +22,9 @@ BASELINE.md's honest-baseline tables), BENCH_ITERS (default 3),
 BENCH_BASELINE_WORKERS (default 8), BENCH_SKIP_BASELINE=1 to skip.
 An unusable accelerator backend falls back to JAX_PLATFORMS=cpu instead of
 failing (subprocess device probe, same pattern as __graft_entry__).
+
+Subcommands: ``--scan`` (ingest microbench), ``--ndv [1e3,1e4,...]``
+(TRINO_TPU_HASH_IMPL hash-vs-sort NDV-ladder bake-off, see run_ndv_bench).
 """
 
 from __future__ import annotations
@@ -274,12 +277,133 @@ def run_scan_bench() -> None:
     }))
 
 
+def run_ndv_bench() -> None:
+    """`bench.py --ndv [1e3,1e4,...]`: the hash-vs-sort NDV-ladder bake-off
+    behind the ROADMAP "Pallas hash build/probe — or a measured waiver" item.
+
+    For each NDV rung, times the two hottest inner loops under every
+    TRINO_TPU_HASH_IMPL implementation:
+
+    - ``agg``:  group-id assignment + one segment-sum over int64 keys
+                (the HashAggregationOperator inner loop).
+    - ``join``: hash-table build + probe-ranges + total fetch
+                (the LookupJoin build/probe inner loop).
+
+    Implementations: ``sort`` (lexsort + searchsorted), ``pallas-interpret``
+    (the open-addressing kernels as pure XLA — runs anywhere, NOT a TPU
+    performance number), and ``pallas`` (compiled kernels — requires a real
+    TPU backend; recorded as ``"skipped"`` with rc 0 otherwise, same spirit
+    as the subprocess device probe).  Keys are drawn from a SPARSE 62-bit
+    domain so the sort leg cannot sneak onto the dense direct-address join
+    fast path.  Emits ONE JSON object with per-leg rows/s + GB/s.
+
+    Env knobs: BENCH_ITERS (default 3), BENCH_NDV_ROWS (default 1e6),
+    BENCH_NDV_INTERPRET_ROWS (default 2e5 — interpret mode executes the
+    probe loops sequentially and would dominate wall time at full width)."""
+    _ensure_backend()
+    _enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.exec import join_exec as JX
+    from trino_tpu.exec import kernels as K
+
+    arg = ""
+    i = sys.argv.index("--ndv")
+    if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
+        arg = sys.argv[i + 1]
+    ndvs = ([int(float(x)) for x in arg.split(",") if x]
+            or [1_000, 10_000, 100_000, 1_000_000])
+
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    full_rows = int(float(os.environ.get("BENCH_NDV_ROWS", "1e6")))
+    interp_rows = int(float(os.environ.get("BENCH_NDV_INTERPRET_ROWS",
+                                           "2e5")))
+    on_tpu = jax.default_backend() == "tpu"
+    impls = [
+        ("sort", {"TRINO_TPU_HASH_IMPL": "sort"}, False),
+        ("pallas-interpret",
+         {"TRINO_TPU_HASH_IMPL": "pallas", "TRINO_TPU_HASH_INTERPRET": "1"},
+         False),
+        ("pallas", {"TRINO_TPU_HASH_IMPL": "pallas"}, True),  # needs TPU
+    ]
+
+    def timed(fn) -> float:
+        fn()  # warmup: compile at this shape
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    rng = np.random.default_rng(0)
+    legs: list[dict] = []
+    for ndv in ndvs:
+        domain = rng.integers(0, 1 << 62, size=ndv, dtype=np.int64)
+        for impl, env, needs_tpu in impls:
+            n = interp_rows if impl == "pallas-interpret" else full_rows
+            nb = max(n // 2, 1)
+            if needs_tpu and not on_tpu:
+                for leg in ("agg", "join"):
+                    legs.append({"leg": leg, "impl": impl, "ndv": ndv,
+                                 "status": "skipped",
+                                 "reason": "no TPU backend"})
+                continue
+            for k in ("TRINO_TPU_HASH_IMPL", "TRINO_TPU_HASH_INTERPRET"):
+                os.environ.pop(k, None)
+            os.environ.update(env)
+            jk = jnp.asarray(domain[rng.integers(0, ndv, size=n)])
+            jv = jnp.asarray(rng.standard_normal(n))
+            jbk = jnp.asarray(domain[rng.integers(0, ndv, size=nb)])
+            jax.block_until_ready((jk, jv, jbk))
+
+            def agg_leg():
+                perm, gid, ng = K.group_ids_auto([(jk, None)], None)
+                jax.block_until_ready(
+                    jax.ops.segment_sum(jv[perm], gid, ng + 1))
+
+            def join_leg():
+                t = JX.build_table([(jbk, None)], num_rows=nb)
+                _lo, _counts, total = JX.probe_ranges_device(
+                    t, [(jk, None)], [None])
+                total.get()
+
+            for leg, fn, nbytes in (
+                    ("agg", agg_leg, n * 16),
+                    ("join", join_leg, (n + nb) * 8)):
+                wall = timed(fn)
+                row = {"leg": leg, "impl": impl, "ndv": ndv, "rows": n,
+                       "wall_ms": round(wall * 1e3, 2),
+                       "rows_per_s": round(n / wall),
+                       "gb_per_s": round(nbytes / wall / 1e9, 3),
+                       "status": "ok"}
+                legs.append(row)
+                print(f"ndv[{ndv}] {leg}/{impl}: {row['wall_ms']} ms = "
+                      f"{row['rows_per_s']:,} rows/s", file=sys.stderr)
+    for k in ("TRINO_TPU_HASH_IMPL", "TRINO_TPU_HASH_INTERPRET"):
+        os.environ.pop(k, None)
+
+    print(json.dumps({
+        "metric": "hash_bakeoff_ndv",
+        "unit": "rows/s",
+        "backend": jax.default_backend(),
+        "iters": iters,
+        "legs": legs,
+    }))
+
+
 def main() -> None:
     if "--baseline" in sys.argv:
         run_baseline()
         return
     if "--scan" in sys.argv:
         run_scan_bench()
+        return
+    if "--ndv" in sys.argv:
+        run_ndv_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

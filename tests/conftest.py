@@ -22,3 +22,10 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for tests"
+
+
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow' inside an 870s budget; the >=1M-NDV hash
+    # bake-off legs opt out via this marker
+    config.addinivalue_line(
+        "markers", "slow: long-running bench-scale tests, excluded by tier-1")

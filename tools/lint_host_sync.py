@@ -34,9 +34,13 @@ PATTERNS: list[tuple[re.Pattern, str]] = [
      "float(np.asarray(...)) blocking sync"),
     (re.compile(r"\.item\(\)"), ".item() blocking sync"),
     (re.compile(r"\bjax\.device_get\("), "raw jax.device_get (use SG.fetch)"),
+    (re.compile(r"block_until_ready\("),
+     "block_until_ready blocking sync (use SG.fetch / SG.async_scalar)"),
 ]
 
-SCAN_DIRS = ("trino_tpu/exec", "trino_tpu/ops")
+# parallel/ rides along: static_agg and the shard_map pipelines promise
+# sync-free bodies, so raw fetches there are as load-bearing a bug as in exec
+SCAN_DIRS = ("trino_tpu/exec", "trino_tpu/ops", "trino_tpu/parallel")
 EXEMPT_FILES = ("syncguard.py",)  # the sanctioned wrapper itself
 PRAGMA = "sync-ok"
 

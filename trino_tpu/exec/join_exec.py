@@ -38,7 +38,7 @@ from ..sql.ir import RowExpression
 from . import kernels as K
 from . import syncguard as SG
 
-__all__ = ["DeviceJoinTable", "build_table", "probe_ranges",
+__all__ = ["DeviceJoinTable", "JoinHashTable", "build_table", "probe_ranges",
            "probe_ranges_device", "run_pairs", "run_unique",
            "ExpandPlanner", "OverflowQueue", "plan_unique_cap"]
 
@@ -56,7 +56,8 @@ class DeviceJoinTable:
     ~120 ms, so per-batch scalar syncs dominated the r4 join profile)."""
 
     __slots__ = ("sorted_hash", "perm", "key_datas",
-                 "num_rows", "_scalars", "_fetched", "dense", "dense_lo")
+                 "num_rows", "_scalars", "_fetched", "dense", "dense_lo",
+                 "hash_idx")
 
     def __init__(self, sorted_hash, perm, key_datas,
                  num_rows: int, scalars):
@@ -73,6 +74,9 @@ class DeviceJoinTable:
         # become ONE gather — no hashing, no binary search, no verify.
         self.dense = None
         self.dense_lo = 0
+        # open-addressing index over the build hashes (TRINO_TPU_HASH_IMPL):
+        # probe_ranges dispatches on it; every downstream program is shared
+        self.hash_idx: Optional["JoinHashTable"] = None
 
     def _fetch(self) -> tuple:
         if self._fetched is None:
@@ -108,6 +112,76 @@ class DeviceJoinTable:
         yields at most this many candidates, so n_probe * max_run bounds the
         pair total — the provable padded-expand cap (ExpandPlanner)."""
         return self._fetch()[2]
+
+
+class JoinHashTable:
+    """Open-addressing index over the build side's 64-bit key hashes
+    (TRINO_TPU_HASH_IMPL, ops/pallas_kernels.py): maps a probe hash to the
+    contiguous run of matching rows in sorted-hash order, replacing the two
+    binary searches of probe_ranges with one kernel probe plus two gathers.
+    The (lo, counts) it yields are value-identical to the searchsorted
+    implementation — both index the SAME sorted order — so every downstream
+    expand/verify/gather program is shared between implementations, and
+    ``build_id = perm[lo + within]`` holds unchanged."""
+
+    __slots__ = ("table_planes", "slot_gid", "group_lo", "group_counts",
+                 "num_slots")
+
+    def __init__(self, table_planes, slot_gid, group_lo, group_counts,
+                 num_slots: int):
+        self.table_planes = table_planes
+        self.slot_gid = slot_gid
+        self.group_lo = group_lo  # [S] first sorted position per hash group
+        self.group_counts = group_counts  # [S] live run length per group
+        self.num_slots = num_slots
+
+
+def _hash_join_enabled(n_rows: int) -> bool:
+    if n_rows == 0 or K.hash_impl() == "sort":
+        return False
+    from ..ops.pallas_kernels import pallas_available
+
+    if not pallas_available():
+        return False
+    if K.hash_impl() == "pallas":
+        return True
+    if K._HASH_IMPL_STATE["failed"] or jax.default_backend() != "tpu":
+        return False
+    # 2 hash planes + slot gids + slack must stay VMEM-honest when compiled
+    return 4 * K.bucket(2 * n_rows) * 4 <= K._HASH_VMEM_BUDGET
+
+
+def _hash_planes(h):
+    """uint64 hash -> the kernels' [2, N] uint32 planes + uint32 slot hash.
+    Plane equality is exactly 64-bit hash equality, so the index reproduces
+    the searchsorted candidate set bit for bit."""
+    planes = jnp.stack([
+        (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        (h >> jnp.uint64(32)).astype(jnp.uint32)])
+    h32 = (h ^ (h >> jnp.uint64(32))).astype(jnp.uint32)
+    return planes, h32
+
+
+@lru_cache(maxsize=None)
+def _hash_index_fn(S: int, n: int, interpret: bool):
+    from ..ops import pallas_kernels as PK
+
+    @jax.jit
+    def fn(sorted_hash):
+        live = sorted_hash < jnp.uint64(_SENT_PROBE)
+        planes, h32 = _hash_planes(sorted_hash)
+        row_gid, _count, table, sgid = PK.hash_insert(
+            planes, h32, live, S, interpret=interpret)
+        # the insert ran over the SORTED hashes: each distinct hash is one
+        # contiguous run, so per-group lo/count are one min- and one
+        # sum-scatter over positions (dead rows carry gid S -> trash slot)
+        pos = jnp.arange(n, dtype=jnp.int64)
+        glo = jnp.full((S + 1,), n, jnp.int64).at[row_gid].min(pos)
+        gcnt = jnp.zeros((S + 1,), jnp.int64).at[row_gid].add(
+            live.astype(jnp.int64))
+        return table, sgid, glo[:S], gcnt[:S]
+
+    return fn
 
 
 @lru_cache(maxsize=None)
@@ -274,9 +348,59 @@ def build_table(keys: Sequence[tuple], live=None,
         except Exception:
             pass
     table = DeviceJoinTable(sh, perm, datas, int(datas[0].shape[0]), scalars)
+    n = table.num_rows
+    if _hash_join_enabled(n):
+        # open-addressing index over the sorted hashes: pure device
+        # programs, zero extra syncs.  Forced 'pallas' propagates failures
+        # (equivalence tests must not silently run the sort path); 'auto'
+        # falls back to searchsorted permanently.
+        S = K.bucket(2 * n)
+        try:
+            table.hash_idx = JoinHashTable(
+                *_hash_index_fn(S, n, K.hash_interpret())(sh), S)
+        except Exception:  # noqa: BLE001
+            if K.hash_impl() == "pallas":
+                raise
+            K._HASH_IMPL_STATE["failed"] = True
     if want_range:
         maybe_build_dense(table, keys, live)
     return table
+
+
+def _probe_hash(num_keys: int, has_valid: tuple, has_remap: tuple,
+                has_live: bool, flat):
+    """Traced: probe-side key hash with NULL/dictionary-miss rows folded to
+    the probe sentinel — the normalization shared by the searchsorted and
+    the open-addressing range implementations.  Returns (h, live)."""
+    i = 0
+    datas, valids = [], []
+    for k in range(num_keys):
+        d = flat[i]
+        i += 1
+        if has_remap[k]:
+            d = flat[i][d]  # dictionary remap table gather
+            i += 1
+        datas.append(d)
+        if has_valid[k]:
+            valids.append(flat[i])
+            i += 1
+        else:
+            valids.append(None)
+    live = flat[i] if has_live else None
+    h = K.hash_combine(datas)
+    pnull = None
+    for k, v in enumerate(valids):
+        nm = ~v if v is not None else None
+        if has_remap[k]:
+            # remapped code -1 = value absent from the build dictionary:
+            # cannot match (but is NOT a null probe for null-aware marks)
+            miss = datas[k] < 0
+            nm = miss if nm is None else (nm | miss)
+        if nm is not None:
+            pnull = nm if pnull is None else (pnull | nm)
+    if pnull is not None:
+        h = jnp.where(pnull, jnp.uint64(_SENT_PROBE), h)
+    return h, live
 
 
 @lru_cache(maxsize=None)
@@ -284,44 +408,40 @@ def _ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
                has_remap: tuple):
     @jax.jit
     def fn(sorted_hash, *flat):
-        i = 0
-        datas, valids = [], []
-        for k in range(num_keys):
-            d = flat[i]
-            i += 1
-            if has_remap[k]:
-                d = flat[i][d]  # dictionary remap table gather
-                i += 1
-            datas.append(d)
-            if has_valid[k]:
-                valids.append(flat[i])
-                i += 1
-            else:
-                valids.append(None)
-        live = flat[i] if has_live else None
-        h = K.hash_combine(datas)
-        pnull = None
-        for k, v in enumerate(valids):
-            nm = ~v if v is not None else None
-            if has_remap[k]:
-                # remapped code -1 = value absent from the build dictionary:
-                # cannot match (but is NOT a null probe for null-aware marks)
-                miss = datas[k] < 0
-                nm = miss if nm is None else (nm | miss)
-            if nm is not None:
-                pnull = nm if pnull is None else (pnull | nm)
-        if pnull is not None:
-            h = jnp.where(pnull, jnp.uint64(_SENT_PROBE), h)
+        h, live = _probe_hash(num_keys, has_valid, has_remap, has_live, flat)
         lo = K.searchsorted(sorted_hash, h, side="left")
         hi = K.searchsorted(sorted_hash, h, side="right")
         counts = hi - lo
-        if pnull is not None:
-            counts = jnp.where(pnull, 0, counts)
         if live is not None:
             counts = jnp.where(live, counts, 0)
         # the build sentinel region (null/dead rows) must never match, and
-        # null probes must not hit it
+        # null/dictionary-miss probes (folded to the probe sentinel by
+        # _probe_hash) must not hit it
         counts = jnp.where(h >= jnp.uint64(_SENT_PROBE), 0, counts)
+        return lo, counts, jnp.sum(counts)
+
+    return fn
+
+
+@lru_cache(maxsize=None)
+def _hash_ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
+                    has_remap: tuple, S: int, interpret: bool):
+    from ..ops import pallas_kernels as PK
+
+    @jax.jit
+    def fn(table_planes, slot_gid, group_lo, group_counts, *flat):
+        h, live = _probe_hash(num_keys, has_valid, has_remap, has_live, flat)
+        ok = h < jnp.uint64(_SENT_PROBE)
+        if live is not None:
+            ok = ok & live
+        planes, h32 = _hash_planes(h)
+        pgid = PK.hash_probe(table_planes, slot_gid, planes, h32, ok,
+                             interpret=interpret)
+        hit = pgid >= 0  # dead/null/miss probe rows come back -1
+        safe = jnp.where(hit, pgid, 0)
+        lo = group_lo[safe]
+        counts = jnp.where(hit, group_counts[safe],
+                           jnp.zeros((), group_counts.dtype))
         return lo, counts, jnp.sum(counts)
 
     return fn
@@ -336,7 +456,7 @@ def probe_ranges_device(table: DeviceJoinTable, probe_keys: Sequence[tuple],
     AsyncScalar whose D2H copy is already in flight."""
     has_valid = tuple(v is not None for _, v in probe_keys)
     has_remap = tuple(r is not None for r in remaps)
-    flat: list = [table.sorted_hash]
+    flat: list = []
     for (d, v), r in zip(probe_keys, remaps):
         flat.append(jnp.asarray(d))
         if r is not None:
@@ -345,8 +465,17 @@ def probe_ranges_device(table: DeviceJoinTable, probe_keys: Sequence[tuple],
             flat.append(jnp.asarray(v))
     if live is not None:
         flat.append(jnp.asarray(live))
-    lo, counts, total = _ranges_fn(
-        len(probe_keys), has_valid, live is not None, has_remap)(*flat)
+    idx = table.hash_idx
+    if idx is not None:
+        lo, counts, total = _hash_ranges_fn(
+            len(probe_keys), has_valid, live is not None, has_remap,
+            idx.num_slots, K.hash_interpret())(
+            idx.table_planes, idx.slot_gid, idx.group_lo,
+            idx.group_counts, *flat)
+    else:
+        lo, counts, total = _ranges_fn(
+            len(probe_keys), has_valid, live is not None, has_remap)(
+            table.sorted_hash, *flat)
     return lo, counts, SG.async_scalar(total, "join.pair-total")
 
 

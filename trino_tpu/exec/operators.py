@@ -1035,7 +1035,11 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                 perm, gid, num_groups, presence, keys_out = (
                     K.group_ids_codes(key_cols, live))
             else:
-                perm, gid, num_groups = K.group_ids(keys, live)
+                # TRINO_TPU_HASH_IMPL routes between the lexsort path and
+                # the Pallas open-addressing path; both honor the same
+                # (perm, gid, num_groups) contract, so everything downstream
+                # (grouped_reduce, group_keys_out) is implementation-blind
+                perm, gid, num_groups = K.group_ids_auto(keys, live)
                 if num_groups == 0:  # every row dead (fully filtered input)
                     return self._empty_result(nk)
                 keys_out = K.group_keys_out(perm, gid, num_groups, keys)

@@ -15,6 +15,7 @@ so scans/joins on different tasks genuinely overlap).
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from typing import Optional
@@ -187,16 +188,24 @@ class DistributedQueryRunner:
         stages: dict[int, _Stage] = {
             f.id: _Stage(f, task_counts[f.id], []) for f in fragments
         }
-        # TIME_SHARING: enqueue backpressure would pin a bounded worker
-        # inside its quantum (sinks have no non-blocking mode yet), so
-        # buffers are uncapped there — the spool-everything trade
-        unbounded = self.session.task_scheduler == "TIME_SHARING"
+        # TIME_SHARING: enqueue backpressure can pin a bounded worker inside
+        # its quantum (sinks have no non-blocking mode yet), so that path
+        # gets a LARGER cap — but a real one: 1 GiB default, never the old
+        # 1 << 62 escape hatch.  TRINO_TPU_SINK_MAX_BYTES overrides both
+        # caps; parking a blocked driver instead of buffering stays a
+        # ROADMAP item ("bounded buffers everywhere").
+        env_cap = os.environ.get("TRINO_TPU_SINK_MAX_BYTES")
+        if env_cap:
+            sink_cap = max(int(env_cap), 1 << 20)
+        elif self.session.task_scheduler == "TIME_SHARING":
+            sink_cap = 1 << 30
+        else:
+            sink_cap = 256 << 20
         for f in fragments:
             tc = stages[f.id].task_count
             nparts = consumer_tasks.get(f.id, 1)
             stages[f.id].buffers = [
-                OutputBuffer(nparts,
-                             max_bytes=(1 << 62) if unbounded else 256 << 20)
+                OutputBuffer(nparts, max_bytes=sink_cap)
                 for _ in range(tc)
             ]
 

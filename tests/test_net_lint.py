@@ -1,0 +1,59 @@
+"""Tier-1 wiring for tools/lint_net_timeout.py: no network call in
+trino_tpu/execution/ may omit an explicit timeout — an unbounded wait on a
+wedged peer is the silent-stall class the resilience layer (Backoff,
+WorkerFailureDetector) exists to eliminate."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(ROOT, "tools", "lint_net_timeout.py")
+
+
+def _mod():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import lint_net_timeout as L
+    finally:
+        sys.path.pop(0)
+    return L
+
+
+def test_no_unbounded_network_calls_in_execution():
+    proc = subprocess.run([sys.executable, LINT], capture_output=True,
+                          text=True, timeout=60)
+    assert proc.returncode == 0, \
+        f"timeout-less network calls crept into execution/:\n{proc.stderr}"
+
+
+def test_lint_catches_planted_violation(tmp_path):
+    """The lint actually fires (guards against pattern rot)."""
+    L = _mod()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "r = urllib.request.urlopen(req)\n"
+        "c = socket.create_connection((host, port))\n"
+        "ok = urllib.request.urlopen(req, timeout=5.0)\n"
+        "exempt = urllib.request.urlopen(req)  # net-ok: test pragma\n")
+    findings = L.lint_file(str(bad))
+    assert len(findings) == 2
+    labels = {f[2] for f in findings}
+    assert any("urlopen" in s for s in labels)
+    assert any("create_connection" in s for s in labels)
+
+
+def test_lint_handles_multiline_calls(tmp_path):
+    """timeout on a continuation line of the SAME call counts; a
+    timeout-less multi-line call is still flagged."""
+    L = _mod()
+    f = tmp_path / "multi.py"
+    f.write_text(
+        "good = urllib.request.urlopen(\n"
+        "    req,\n"
+        "    timeout=30.0)\n"
+        "bad = urllib.request.urlopen(\n"
+        "    req)\n")
+    findings = L.lint_file(str(f))
+    assert len(findings) == 1
+    assert findings[0][1] == 4

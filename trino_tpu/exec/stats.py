@@ -99,6 +99,73 @@ class ScanIngestStats:
 
 
 @dataclass
+class ResilienceStats:
+    """Counters for the query-level resilience layer (retry_policy=QUERY,
+    heartbeat detection, worker replacement, exchange backoff) — the
+    QueryStats/tracing surface of execution/failure_detector.py and the
+    remote runner's retry loop."""
+
+    query_retries: int = 0
+    backoff_waits: int = 0
+    backoff_wait_s: float = 0.0
+    blacklisted_workers: int = 0
+    worker_replacements: int = 0
+    heartbeat_transitions: int = 0
+    exchange_fetch_failures: int = 0
+    exchange_backoff_trips: int = 0
+
+    def merge(self, other: "ResilienceStats") -> None:
+        self.query_retries += other.query_retries
+        self.backoff_waits += other.backoff_waits
+        self.backoff_wait_s += other.backoff_wait_s
+        self.blacklisted_workers += other.blacklisted_workers
+        self.worker_replacements += other.worker_replacements
+        self.heartbeat_transitions += other.heartbeat_transitions
+        self.exchange_fetch_failures += other.exchange_fetch_failures
+        self.exchange_backoff_trips += other.exchange_backoff_trips
+
+    @classmethod
+    def delta(cls, after: "ResilienceStats",
+              before: "ResilienceStats") -> "ResilienceStats":
+        """after - before, field-wise (runner counters are cumulative; a
+        query's own numbers are the delta across its retry loop)."""
+        return cls(
+            query_retries=after.query_retries - before.query_retries,
+            backoff_waits=after.backoff_waits - before.backoff_waits,
+            backoff_wait_s=after.backoff_wait_s - before.backoff_wait_s,
+            blacklisted_workers=(after.blacklisted_workers
+                                 - before.blacklisted_workers),
+            worker_replacements=(after.worker_replacements
+                                 - before.worker_replacements),
+            heartbeat_transitions=(after.heartbeat_transitions
+                                   - before.heartbeat_transitions),
+            exchange_fetch_failures=(after.exchange_fetch_failures
+                                     - before.exchange_fetch_failures),
+            exchange_backoff_trips=(after.exchange_backoff_trips
+                                    - before.exchange_backoff_trips),
+        )
+
+    @property
+    def any(self) -> bool:
+        return any((self.query_retries, self.backoff_waits,
+                    self.blacklisted_workers, self.worker_replacements,
+                    self.heartbeat_transitions, self.exchange_fetch_failures,
+                    self.exchange_backoff_trips))
+
+    def text(self) -> str:
+        return (
+            f"resilience: {self.query_retries} query retries "
+            f"({self.backoff_waits} backoff waits, "
+            f"{self.backoff_wait_s * 1e3:.0f} ms), "
+            f"{self.blacklisted_workers} blacklists, "
+            f"{self.worker_replacements} worker replacements, "
+            f"{self.heartbeat_transitions} heartbeat transitions, "
+            f"{self.exchange_fetch_failures} exchange fetch failures "
+            f"({self.exchange_backoff_trips} backoff trips)"
+        )
+
+
+@dataclass
 class OperatorStats:
     name: str
     input_rows: int = 0
@@ -121,6 +188,7 @@ class QueryStats:
     pipelines: list[PipelineStats] = field(default_factory=list)
     scan: ScanIngestStats | None = None
     sync: "object | None" = None  # syncguard.SyncStats delta for this query
+    resilience: ResilienceStats | None = None  # retry/heartbeat delta
 
     def merge_scan(self, ingest: ScanIngestStats) -> None:
         if self.scan is None:
@@ -142,6 +210,8 @@ class QueryStats:
             lines.append("  " + self.scan.text())
         if self.sync is not None and self.sync.host_syncs:
             lines.append("  " + self.sync.text())
+        if self.resilience is not None and self.resilience.any:
+            lines.append("  " + self.resilience.text())
         for i, p in enumerate(self.pipelines):
             lines.append(f"  pipeline {i}:")
             for op in p.operators:

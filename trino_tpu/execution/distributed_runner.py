@@ -84,6 +84,14 @@ class DistributedQueryRunner:
         self.event_listeners = EventListenerManager()
         self.access_control = AccessControlManager()
         self._qids = itertools.count(1)
+        # query-level resilience surface (retry_policy=QUERY): cumulative
+        # counters + an append-only event log of retries / blacklists /
+        # heartbeat transitions / replacements, shared with the process
+        # runner's WorkerFailureDetector
+        from ..exec.stats import ResilienceStats
+
+        self.resilience = ResilienceStats()
+        self.resilience_events: list = []
 
     # ------------------------------------------------------------------ plan
     def create_plan(self, sql: str) -> PlanNode:
@@ -172,16 +180,84 @@ class DistributedQueryRunner:
 
     def _execute_subplan(self, subplan: SubPlan,
                          stats_sink: Optional[list]) -> QueryResult:
-        from .collective_exchange import (
-            CollectiveRepartitionExchange,
-            collectives_available,
-        )
-
         if self.session.retry_policy == "TASK":
             from .fte import run_fte_query
 
             return self._to_result(subplan, run_fte_query(self, subplan,
                                                           stats_sink))
+        if self.session.retry_policy == "QUERY":
+            return self._run_query_retry(subplan, stats_sink)
+        return self._run_streaming(subplan, stats_sink)
+
+    def _run_query_retry(self, subplan: SubPlan,
+                         stats_sink: Optional[list]) -> QueryResult:
+        """retry_policy=QUERY: streaming execution with coordinator-level
+        retry (reference: coordinator query retries — the pipelined overlap
+        is kept; the recovery unit is the whole query).  On a retryable
+        failure: blacklist the implicated worker for this query, replace
+        GONE workers (``_prepare_retry``), back off deterministically, and
+        re-run the subplan.  USER-classified errors fail fast, always."""
+        import time as _time
+
+        from ..exec.stats import ResilienceStats
+        from ..spi.errors import Backoff, classify
+
+        sess = self.session
+        before = ResilienceStats()
+        before.merge(self.resilience)
+        backoff = Backoff(min_delay_s=sess.retry_initial_delay_s,
+                          max_delay_s=sess.retry_max_delay_s,
+                          max_failure_duration_s=float("inf"))
+        blacklist: set = set()
+        attempts = 1 + max(0, int(sess.query_retry_attempts))
+        try:
+            for attempt in range(attempts):
+                try:
+                    return self._run_streaming(
+                        subplan, stats_sink, attempt=attempt,
+                        blacklist=frozenset(blacklist))
+                except BaseException as e:  # noqa: BLE001 — classified below
+                    te = classify(e)
+                    if not te.is_retryable() or attempt == attempts - 1:
+                        raise
+                    if te.remote_host and te.remote_host not in blacklist:
+                        blacklist.add(te.remote_host)
+                        self.resilience.blacklisted_workers += 1
+                        self.resilience_events.append(
+                            ("blacklist", te.remote_host, te.code.name))
+                    self._prepare_retry()
+                    backoff.failure()
+                    delay = backoff.delay_s
+                    self.resilience.query_retries += 1
+                    self.resilience.backoff_waits += 1
+                    self.resilience.backoff_wait_s += delay
+                    self.resilience_events.append(
+                        ("query_retry", attempt + 1, te.code.name, delay))
+                    _time.sleep(delay)
+            raise AssertionError("unreachable: retry loop exhausted")
+        finally:
+            delta = ResilienceStats.delta(self.resilience, before)
+            if delta.any:
+                from .tracing import annotate_resilience_span
+
+                span = self.tracer.current()
+                if span is not None:
+                    annotate_resilience_span(span, delta)
+                if stats_sink is not None:
+                    stats_sink.append(QueryStats(label="resilience:",
+                                                 resilience=delta))
+
+    def _prepare_retry(self) -> None:
+        """Hook run between query-retry attempts; the process runner
+        overrides it to sweep heartbeats and replace GONE workers."""
+
+    def _run_streaming(self, subplan: SubPlan, stats_sink: Optional[list],
+                       attempt: int = 0,
+                       blacklist: frozenset = frozenset()) -> QueryResult:
+        from .collective_exchange import (
+            CollectiveRepartitionExchange,
+            collectives_available,
+        )
 
         fragments = subplan.all_fragments()
         task_counts, consumer_tasks = self.stage_task_counts(fragments)
@@ -229,7 +305,8 @@ class DistributedQueryRunner:
         errors: list[BaseException] = []
         if self.session.task_scheduler == "TIME_SHARING":
             hung = self._run_time_sharing(
-                fragments, stages, errors, stats_sink, collective_edges)
+                fragments, stages, errors, stats_sink, collective_edges,
+                attempt)
         else:
             threads: list[threading.Thread] = []
             for f in fragments:
@@ -238,7 +315,7 @@ class DistributedQueryRunner:
                     th = threading.Thread(
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
-                              collective_edges),
+                              collective_edges, attempt),
                         name=f"task-{f.id}.{t}",
                         daemon=True,
                     )
@@ -390,8 +467,18 @@ class DistributedQueryRunner:
     def _build_task(self, stage: _Stage, task_index: int,
                     stages: dict[int, "_Stage"],
                     stats_sink: Optional[list],
-                    collective: dict) -> tuple[list, Optional[QueryStats]]:
+                    collective: dict,
+                    attempt: int = 0) -> tuple[list, Optional[QueryStats]]:
         f = stage.fragment
+        # engine-level fault injection on the in-process streaming path,
+        # keyed by (fragment, task, attempt) exactly like the FTE path —
+        # this is what makes retry_policy=QUERY deterministically testable
+        injector = getattr(self.session, "failure_injector", None)
+        if injector is not None:
+            from .failure_injector import TASK_FAILURE
+
+            injector.maybe_stall(f.id, task_index, attempt)
+            injector.maybe_fail(TASK_FAILURE, f.id, task_index, attempt)
         clients = {}
         for src in f.source_fragments:
             if src in collective:
@@ -433,7 +520,7 @@ class DistributedQueryRunner:
         return local.pipelines, stats
 
     def _run_time_sharing(self, fragments, stages, errors, stats_sink,
-                          collective) -> list[str]:
+                          collective, attempt: int = 0) -> list[str]:
         """Schedule every task on a bounded MLFQ executor
         (exec/executor.py); returns the names of tasks that never finished."""
         import time as _time
@@ -443,13 +530,24 @@ class DistributedQueryRunner:
         executor = TimeSharingTaskExecutor(self.session.executor_workers)
         try:
             handles = []
-            for f in fragments:
-                stage = stages[f.id]
-                for t in range(stage.task_count):
-                    pipelines, stats = self._build_task(
-                        stage, t, stages, stats_sink, collective)
-                    handles.append((f, t, executor.submit(pipelines, stats),
-                                    pipelines))
+            try:
+                for f in fragments:
+                    stage = stages[f.id]
+                    for t in range(stage.task_count):
+                        pipelines, stats = self._build_task(
+                            stage, t, stages, stats_sink, collective, attempt)
+                        handles.append(
+                            (f, t, executor.submit(pipelines, stats),
+                             pipelines))
+            except BaseException:
+                # a task that failed to BUILD (e.g. injected fault) must not
+                # leave already-submitted siblings blocked on its buffers
+                for s in stages.values():
+                    for b in s.buffers:
+                        b.abort()
+                for ex in collective.values():
+                    ex.abort()
+                raise
             # poll every handle so the FIRST failure aborts all buffers
             # immediately (matching THREADS-mode fail-fast)
             from .task import STALL_TIMEOUT_S
@@ -493,17 +591,22 @@ class DistributedQueryRunner:
     def _run_task(self, stage: _Stage, task_index: int,
                   stages: dict[int, "_Stage"], errors: list,
                   stats_sink: Optional[list] = None,
-                  collective: Optional[dict] = None) -> None:
+                  collective: Optional[dict] = None,
+                  attempt: int = 0) -> None:
         try:
             pipelines, stats = self._build_task(
-                stage, task_index, stages, stats_sink, collective or {})
+                stage, task_index, stages, stats_sink, collective or {},
+                attempt)
             run_pipelines(pipelines, stats)
         except BaseException as e:  # noqa: BLE001 — surfaced to coordinator
             errors.append(e)
             # unblock every sibling immediately: producers stuck in enqueue
-            # backpressure and consumers polling this (now dead) task would
+            # backpressure, consumers polling this (now dead) task, and
+            # partners parked at a collective all_to_all barrier would
             # otherwise wait out the full join timeout before the real error
             # surfaces
             for s in stages.values():
                 for b in s.buffers:
                     b.abort()
+            for ex in (collective or {}).values():
+                ex.abort()

@@ -113,6 +113,15 @@ def run_fte_query(runner, subplan: SubPlan,
                     return
                 except Exception as e:  # retried; interrupts propagate
                     last = e
+                    from ..spi.errors import classify
+
+                    if not classify(e).is_retryable():
+                        # USER-classified failure: re-running re-runs the
+                        # same bug — fail the task NOW, no retry chain
+                        if kind == "STANDARD":
+                            failures[t] = TaskFailure(
+                                f.id, t, attempt + 1, last)
+                        return
                     if isinstance(e, ExceededMemoryLimitError):
                         mem_mult *= mem_growth
                         if events is not None:

@@ -29,7 +29,17 @@ from typing import Optional
 
 from ..runner import QueryResult, Session
 from ..spi.batch import ColumnBatch
+from ..spi.errors import (
+    NO_NODES_AVAILABLE,
+    PAGE_TRANSPORT_TIMEOUT,
+    REMOTE_HOST_GONE,
+    Backoff,
+    TrinoError,
+    classify,
+    lookup_code,
+)
 from .distributed_runner import DistributedQueryRunner
+from .failure_detector import GONE, NodeGoneError, WorkerFailureDetector
 from .failure_injector import GET_RESULTS_FAILURE
 from .fragmenter import SubPlan
 from .serde import deserialize_batch
@@ -55,33 +65,86 @@ def _http(method: str, url: str, data: Optional[bytes] = None,
 class HttpExchangeClient:
     """Pulls one partition from many upstream task result URIs; same
     poll/is_finished surface as the in-process ExchangeClient so operators
-    are transport-agnostic."""
+    are transport-agnostic.
 
-    def __init__(self, task_uris: list[str], partition: int):
-        # [uri, token, done]
-        self._sources = [[u, 0, False] for u in task_uris]
+    Each source carries a deterministic :class:`Backoff`
+    (HttpPageBufferClient.java:355's role): transient fetch failures skip
+    the source until its delay gate reopens, and once failures persist past
+    ``max_failure_duration_s`` the source surfaces as a classified EXTERNAL
+    :class:`TrinoError` instead of spinning silently until the query
+    deadline.  ``backoff`` is a config dict
+    (min_delay_s / max_delay_s / max_failure_duration_s) so it travels in
+    task descriptors."""
+
+    def __init__(self, task_uris: list[str], partition: int,
+                 backoff: Optional[dict] = None):
+        cfg = backoff or {}
+        # [uri, token, done, Backoff]
+        self._sources = [[u, 0, False, Backoff(
+            min_delay_s=cfg.get("min_delay_s", 0.05),
+            max_delay_s=cfg.get("max_delay_s", 2.0),
+            max_failure_duration_s=cfg.get("max_failure_duration_s", 120.0),
+        )] for u in task_uris]
         self.partition = partition
         self._ready: list[ColumnBatch] = []
+        # per-client counters, folded into ResilienceStats by the runner
+        self.stats = {"fetch_failures": 0, "backoff_skips": 0,
+                      "backoff_trips": 0,
+                      "failures_by_source": {u: 0 for u in task_uris}}
+
+    @staticmethod
+    def _host_of(uri: str) -> str:
+        # ".../v1/task/<id>" -> worker base URL, the blacklist key
+        return uri.split("/v1/", 1)[0]
 
     def _fetch(self, s, timeout: float) -> int:
-        uri, token, _done = s
-        url = f"{uri}/results/{self.partition}/{token}"
+        uri, token, _done, backoff = s
+        # the server bounds its long-poll to maxwait (worker.py honors it),
+        # so a short poll really IS short; the socket timeout only needs a
+        # small grace on top for page serialization + transfer
+        maxwait = min(max(timeout, 0.0), 5.0)
+        url = f"{uri}/results/{self.partition}/{token}?maxwait={maxwait:g}"
         try:
-            with _http("GET", url, timeout=max(timeout, 5.0)) as resp:
+            with _http("GET", url, timeout=maxwait + 5.0) as resp:
                 body = resp.read()
                 next_token = int(resp.headers.get("X-Next-Token", token))
                 done = bool(int(resp.headers.get("X-Done", 0)))
         except urllib.error.HTTPError as e:
             if e.code == 404:  # task not created yet: transient
                 return 0
-            raise RuntimeError(
-                f"exchange fetch failed ({e.code}): "
-                f"{e.read()[:500]!r}") from e
-        except (urllib.error.URLError, ConnectionError, TimeoutError):
-            # worker unreachable: no-progress here; the coordinator's task
-            # status sweep decides whether the producer is GONE and fails
-            # the query (HttpPageBufferClient's backoff role)
+            # a FAILED task's 500 body carries its own classification
+            # (worker.py status JSON) — keep it, so a worker-side USER
+            # error stays USER (fail-fast) instead of degrading to a
+            # retryable transport error
+            detail = e.read()[:500]
+            code_name = error_type = None
+            try:
+                info = json.loads(detail)
+                code_name = info.get("error_code")
+                error_type = info.get("error_type")
+                detail = info.get("error") or detail
+            except Exception:
+                pass
+            raise TrinoError(
+                lookup_code(code_name or "REMOTE_TASK_ERROR", error_type),
+                f"exchange fetch failed ({e.code}): {detail!r}",
+                remote_host=self._host_of(uri)) from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            # worker unreachable: back off; once failures persist past the
+            # failure-duration budget this producer is DECLARED failed
+            self.stats["fetch_failures"] += 1
+            self.stats["failures_by_source"][uri] += 1
+            if backoff.failure():
+                self.stats["backoff_trips"] += 1
+                raise TrinoError(
+                    PAGE_TRANSPORT_TIMEOUT,
+                    f"producer {uri} unreachable for "
+                    f"{backoff.failure_duration_s:.1f}s "
+                    f"({backoff.failure_count} attempts): "
+                    f"{type(e).__name__}: {e}",
+                    remote_host=self._host_of(uri)) from e
             return 0
+        backoff.success()
         count = 0
         pos = 0
         while pos + 4 <= len(body):
@@ -100,12 +163,15 @@ class HttpExchangeClient:
         for s in self._sources:
             if s[2]:
                 continue
+            if not s[3].ready():  # delay gate closed: skip this round
+                self.stats["backoff_skips"] += 1
+                continue
             if self._fetch(s, timeout):
                 return self._ready.pop(0)
         return None
 
     def is_finished(self) -> bool:
-        return not self._ready and all(done for _, _, done in self._sources)
+        return not self._ready and all(s[2] for s in self._sources)
 
 
 class HttpRemoteTask:
@@ -126,7 +192,9 @@ class HttpRemoteTask:
             with _http("GET", f"{self.uri}/status", timeout=10.0) as resp:
                 return json.loads(resp.read())
         except (urllib.error.URLError, ConnectionError) as e:
-            return {"state": "GONE", "error": str(e)}
+            return {"state": "GONE", "error": str(e),
+                    "error_type": "EXTERNAL",
+                    "error_code": "REMOTE_HOST_GONE"}
 
     def cancel(self) -> None:
         try:
@@ -139,9 +207,17 @@ _SECRET_LOCK = threading.Lock()
 
 
 class WorkerProcess:
-    """One spawned worker (python -m trino_tpu.execution.worker)."""
+    """One spawned worker (python -m trino_tpu.execution.worker).
 
-    def __init__(self, env_overrides: Optional[dict] = None):
+    Boot is bounded: a worker that dies (or wedges) before printing
+    ``LISTENING`` raises within ``boot_timeout_s`` with its captured stderr
+    in the message, instead of blocking the coordinator forever on
+    ``stdout.readline()``."""
+
+    def __init__(self, env_overrides: Optional[dict] = None,
+                 boot_timeout_s: float = 60.0):
+        import tempfile
+
         # one shared secret per cluster process tree: minted on first spawn,
         # inherited by every worker and by worker->worker exchange fetches
         with _SECRET_LOCK:
@@ -151,17 +227,44 @@ class WorkerProcess:
                 os.environ["TRINO_TPU_INTERNAL_SECRET"] = secrets.token_hex(16)
         env = dict(os.environ)
         env.update(env_overrides or {})
+        self._stderr = tempfile.TemporaryFile(mode="w+")
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "trino_tpu.execution.worker", "--port", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=self._stderr,
             text=True, env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))))
-        line = self.proc.stdout.readline()
-        if not line.startswith("LISTENING"):
-            raise RuntimeError(f"worker failed to boot: {line!r}")
+        box: list[str] = []
+        reader = threading.Thread(
+            target=lambda: box.append(self.proc.stdout.readline() or ""),
+            daemon=True)
+        reader.start()
+        reader.join(timeout=boot_timeout_s)
+        line = box[0] if box else None
+        if line is None or not line.startswith("LISTENING"):
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+            except Exception:
+                pass
+            reader.join(timeout=5)
+            why = ("timed out after "
+                   f"{boot_timeout_s}s" if line is None else f"got {line!r}")
+            raise RuntimeError(
+                f"worker failed to boot ({why}); stderr: "
+                f"{self.stderr_tail()!r}")
         self.port = int(line.split()[1])
         self.url = f"http://127.0.0.1:{self.port}"
+
+    def stderr_tail(self, limit: int = 2000) -> str:
+        try:
+            self._stderr.flush()
+            self._stderr.seek(0, os.SEEK_END)
+            size = self._stderr.tell()
+            self._stderr.seek(max(0, size - limit))
+            return self._stderr.read()
+        except Exception:
+            return "<unavailable>"
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -196,11 +299,87 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         super().__init__(build_catalog(catalog_spec),
                          worker_count=worker_count, session=session)
         self.catalog_spec = catalog_spec
+        self._env_overrides = env_overrides
         self.workers = [WorkerProcess(env_overrides)
                         for _ in range(worker_count)]
         self._query_seq = 0
+        # replace the base in-process pinger with the real heartbeat sweep
+        # over worker /v1/status (execution/failure_detector.py); shares the
+        # resilience event log so transitions land in the same timeline as
+        # retries and replacements
+        sess = self.session
+        self.failure_detector = WorkerFailureDetector(
+            heartbeat_interval_s=sess.heartbeat_interval_s,
+            failure_threshold=sess.heartbeat_failure_threshold,
+            events=self.resilience_events)
+        for w in self.workers:
+            self._monitor_worker(w)
+        self._replacements_used = 0
+
+    def _monitor_worker(self, w: WorkerProcess) -> None:
+        def probe() -> dict:
+            if not w.alive():
+                raise NodeGoneError(
+                    f"worker process exited rc={w.proc.poll()}")
+            with _http("GET", f"{w.url}/v1/status", timeout=2.0) as resp:
+                return json.loads(resp.read())
+
+        self.failure_detector.monitor(w.url, probe)
+
+    def _placement_workers(self, blacklist: frozenset = frozenset()
+                           ) -> list[WorkerProcess]:
+        """Task placement targets: live worker processes whose heartbeat
+        state is ACTIVE (draining and unresponsive nodes get no new tasks),
+        minus the query's blacklist.  Falls back to ignoring the blacklist
+        rather than returning nothing (a 1-worker cluster must still place
+        after a blacklisting retry)."""
+        self.failure_detector.maybe_sweep()
+        states = self.failure_detector.states()
+        live = [w for w in self.workers
+                if w.alive() and states.get(w.url, "ACTIVE") == "ACTIVE"]
+        placeable = [w for w in live if w.url not in blacklist]
+        return placeable or live
+
+    @property
+    def active_worker_count(self) -> int:
+        """Heartbeat-gated worker count (overrides the base property, which
+        consults the in-process control-plane pinger)."""
+        return len(self._placement_workers()) or self.worker_count
+
+    def _replace_gone_workers(self) -> None:
+        """Self-heal cluster capacity: respawn a WorkerProcess for every
+        GONE node, bounded by ``Session.max_worker_replacements`` over the
+        runner's lifetime."""
+        self.failure_detector.sweep_once()
+        for i, w in enumerate(self.workers):
+            if self.failure_detector.state_of(w.url) != GONE:
+                continue
+            if self._replacements_used >= self.session.max_worker_replacements:
+                self.resilience_events.append(
+                    ("replacement_cap", w.url,
+                     self.session.max_worker_replacements))
+                continue
+            replacement = WorkerProcess(self._env_overrides)
+            self._replacements_used += 1
+            self.resilience.worker_replacements += 1
+            self.resilience_events.append(
+                ("worker_replaced", w.url, replacement.url))
+            self.failure_detector.unmonitor(w.url)
+            self._monitor_worker(replacement)
+            self.workers[i] = replacement
+            try:
+                if w.alive():
+                    w.kill()
+            except Exception:
+                pass
+
+    def _prepare_retry(self) -> None:
+        """Between query-retry attempts: sweep heartbeats and respawn GONE
+        workers so the re-run sees healed capacity."""
+        self._replace_gone_workers()
 
     def close(self) -> None:
+        self.failure_detector.stop()
         for w in self.workers:
             w.shutdown()
 
@@ -225,9 +404,9 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
 
         from .fte import fte_task_dir
 
-        alive = [w for w in self.workers if w.alive()]
+        alive = self._placement_workers()
         if not alive:
-            raise RuntimeError("no live workers")
+            raise TrinoError(NO_NODES_AVAILABLE, "no live workers")
         w = alive[(fragment.id * 31 + task_index + attempt) % len(alive)]
         self._query_seq += 1
         task_dir = fte_task_dir(spool_root, fragment.id, task_index)
@@ -268,8 +447,12 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
             if st["state"] == "FINISHED":
                 break
             if st["state"] in ("FAILED", "GONE", "CANCELED"):
-                raise RuntimeError(
-                    f"attempt failed ({st['state']}): {st.get('error')}")
+                # classified so the FTE retry chain can fail fast on USER
+                # errors and keep retrying EXTERNAL/INTERNAL ones
+                raise TrinoError(
+                    lookup_code(st.get("error_code"), st.get("error_type")),
+                    f"attempt failed ({st['state']}): {st.get('error')}",
+                    remote_host=w.url)
             if time.monotonic() > deadline:
                 rt.cancel()
                 raise TimeoutError("fte attempt stalled")
@@ -287,86 +470,143 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         return expected
 
     # ------------------------------------------------------------- execution
-    def _execute_subplan(self, subplan: SubPlan,
-                         stats_sink: Optional[list]) -> QueryResult:
-        if self.session.retry_policy == "TASK":
-            from .fte import run_fte_query
+    def _run_streaming(self, subplan: SubPlan, stats_sink: Optional[list],
+                       attempt: int = 0,
+                       blacklist: frozenset = frozenset()) -> QueryResult:
+        # the base class dispatches retry_policy (TASK -> fte, QUERY -> the
+        # query-retry loop); both land here for the actual remote run
+        return self._run_remote(subplan, attempt=attempt,
+                                blacklist=blacklist)
 
-            return self._to_result(
-                subplan, run_fte_query(self, subplan, stats_sink))
-        return self._run_remote(subplan)
+    def _exchange_backoff_cfg(self) -> dict:
+        sess = self.session
+        return {"min_delay_s": sess.exchange_backoff_min_s,
+                "max_delay_s": sess.exchange_backoff_max_s,
+                "max_failure_duration_s":
+                    sess.exchange_max_failure_duration_s}
 
-    def _run_remote(self, subplan: SubPlan) -> QueryResult:
+    def _check_workers(self, by_worker: dict) -> None:
+        """One heartbeat-cadence sweep: a single cached /v1/status per
+        WORKER (not per task) decides node death and task failure — the old
+        per-task loop made the sweep itself the stall (10 s status timeout
+        x N tasks against one hung worker)."""
+        self.failure_detector.sweep_once()
+        for wurl, owned in by_worker.items():
+            if self.failure_detector.state_of(wurl) == GONE:
+                raise TrinoError(
+                    REMOTE_HOST_GONE,
+                    f"worker {wurl} ({len(owned)} tasks): "
+                    f"{self.failure_detector.last_error(wurl)}",
+                    remote_host=wurl)
+            status = self.failure_detector.last_status(wurl) or {}
+            task_states = status.get("tasks", {})
+            for fid, t, task_id in owned:
+                st = task_states.get(task_id)
+                if st is not None and st["state"] == "FAILED":
+                    raise TrinoError(
+                        lookup_code(st.get("error_code"),
+                                    st.get("error_type")),
+                        f"task f{fid}.t{t} FAILED: {st.get('error')}",
+                        remote_host=wurl)
+
+    def _run_remote(self, subplan: SubPlan, attempt: int = 0,
+                    blacklist: frozenset = frozenset()) -> QueryResult:
         self._query_seq += 1
         qid = f"pq{self._query_seq}"
         fragments = subplan.all_fragments()
         task_counts, consumer_tasks = self.stage_task_counts(fragments)
-        alive = [w for w in self.workers if w.alive()]
+        alive = self._placement_workers(blacklist)
         if not alive:
-            raise RuntimeError("no live workers")
+            raise TrinoError(NO_NODES_AVAILABLE, "no live workers")
+        injector = getattr(self.session, "failure_injector", None)
 
         # deterministic placement: task t of fragment f -> alive worker
         # (f*31 + t) % n  (UniformNodeSelector's role, minus locality)
         tasks: dict[tuple[int, int], HttpRemoteTask] = {}
+        by_worker: dict[str, list] = {}
         for f in fragments:
             for t in range(task_counts[f.id]):
                 w = alive[(f.id * 31 + t) % len(alive)]
-                tasks[(f.id, t)] = HttpRemoteTask(w.url, f"{qid}_f{f.id}_t{t}")
+                rt = HttpRemoteTask(w.url, f"{qid}_f{f.id}_t{t}")
+                tasks[(f.id, t)] = rt
+                by_worker.setdefault(w.url, []).append((f.id, t, rt.task_id))
 
         by_id = {f.id: f for f in fragments}
-        for f in fragments:
-            tc = task_counts[f.id]
-            for t in range(tc):
-                upstream = {}
-                for src in f.source_fragments:
-                    src_tasks = [tasks[(src, i)].uri
-                                 for i in range(task_counts[src])]
-                    upstream[src] = {
-                        "uris": src_tasks,
-                        "merge": by_id[src].output_kind == "MERGE",
-                    }
-                desc = {
-                    "fragment": f,
-                    "task_index": t,
-                    "task_count": tc,
-                    "num_partitions": consumer_tasks.get(f.id, 1),
-                    "upstream": upstream,
-                    "catalog": self.catalog_spec,
-                    "splits_per_node": self.session.splits_per_node,
-                    "node_count": self.worker_count,
-                    "dynamic_filtering": self.session.dynamic_filtering,
-                    "hbm_limit_bytes": self.session.hbm_limit_bytes,
-                }
-                tasks[(f.id, t)].create(desc)
-
-        # drain the root fragment's partition 0 as the client, watching
-        # task statuses (fail fast on any FAILED task)
-        root = subplan.fragment
-        root_uris = [tasks[(root.id, t)].uri
-                     for t in range(task_counts[root.id])]
-        client = HttpExchangeClient(root_uris, 0)
-        batches: list[ColumnBatch] = []
-        deadline = time.monotonic() + 600
-        last_status = 0.0
+        client = None
         try:
+            for f in fragments:
+                tc = task_counts[f.id]
+                for t in range(tc):
+                    upstream = {}
+                    for src in f.source_fragments:
+                        src_tasks = [tasks[(src, i)].uri
+                                     for i in range(task_counts[src])]
+                        upstream[src] = {
+                            "uris": src_tasks,
+                            "merge": by_id[src].output_kind == "MERGE",
+                        }
+                    desc = {
+                        "fragment": f,
+                        "task_index": t,
+                        "task_count": tc,
+                        "num_partitions": consumer_tasks.get(f.id, 1),
+                        "attempt": attempt,
+                        "upstream": upstream,
+                        "catalog": self.catalog_spec,
+                        "splits_per_node": self.session.splits_per_node,
+                        "node_count": self.worker_count,
+                        "dynamic_filtering": self.session.dynamic_filtering,
+                        "hbm_limit_bytes": self.session.hbm_limit_bytes,
+                        "exchange_backoff": self._exchange_backoff_cfg(),
+                        "failure_rules": (
+                            injector.consume_for(
+                                f.id, t, attempt,
+                                # leaves never reach the results-read
+                                # injection point
+                                unreachable=(set() if upstream
+                                             else {GET_RESULTS_FAILURE}))
+                            if injector is not None else []),
+                    }
+                    rt = tasks[(f.id, t)]
+                    try:
+                        rt.create(desc)
+                    except BaseException as e:  # noqa: BLE001
+                        te = classify(e)
+                        te.remote_host = te.remote_host or \
+                            HttpExchangeClient._host_of(rt.uri)
+                        raise te from e
+
+            # drain the root fragment's partition 0 as the client; ONE
+            # status poll per worker at heartbeat cadence decides failure
+            root = subplan.fragment
+            root_uris = [tasks[(root.id, t)].uri
+                         for t in range(task_counts[root.id])]
+            client = HttpExchangeClient(root_uris, 0,
+                                        backoff=self._exchange_backoff_cfg())
+            batches: list[ColumnBatch] = []
+            deadline = time.monotonic() + 600
+            last_status = 0.0
             while not client.is_finished():
                 b = client.poll(timeout=0.2)
                 if b is not None:
                     batches.append(b)
                     continue
                 now = time.monotonic()
-                if now - last_status > 1.0:
+                if now - last_status > self.session.heartbeat_interval_s:
                     last_status = now
-                    for (fid, t), rt in tasks.items():
-                        st = rt.status()
-                        if st["state"] in ("FAILED", "GONE"):
-                            raise RuntimeError(
-                                f"task f{fid}.t{t} {st['state']}: "
-                                f"{st.get('error')}")
+                    self._check_workers(by_worker)
                 if now > deadline:
                     raise TimeoutError("remote query stalled")
+            return self._to_result(subplan, batches)
         except BaseException:
             for rt in tasks.values():
                 rt.cancel()
             raise
-        return self._to_result(subplan, batches)
+        finally:
+            if client is not None:
+                self.resilience.exchange_fetch_failures += \
+                    client.stats["fetch_failures"]
+                self.resilience.exchange_backoff_trips += \
+                    client.stats["backoff_trips"]
+            self.resilience.heartbeat_transitions = \
+                self.failure_detector.transitions

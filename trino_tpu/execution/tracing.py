@@ -14,7 +14,24 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-__all__ = ["Span", "Tracer", "annotate_scan_span", "annotate_sync_span"]
+__all__ = ["Span", "Tracer", "annotate_scan_span", "annotate_sync_span",
+           "annotate_resilience_span"]
+
+
+def annotate_resilience_span(span: "Span", res) -> None:
+    """Set the ``trino.exec.*`` resilience attributes from a ResilienceStats
+    delta (exec/stats.py) so exporters see retries, backoff waits, worker
+    replacements and heartbeat churn next to the query wall time."""
+    if res is None or not res.any:
+        return
+    span.set("trino.exec.query-retries", res.query_retries)
+    span.set("trino.exec.backoff-waits", res.backoff_waits)
+    span.set("trino.exec.backoff-wait-ms", round(res.backoff_wait_s * 1e3, 1))
+    span.set("trino.exec.blacklisted-workers", res.blacklisted_workers)
+    span.set("trino.exec.worker-replacements", res.worker_replacements)
+    span.set("trino.exec.heartbeat-transitions", res.heartbeat_transitions)
+    span.set("trino.exec.exchange-fetch-failures", res.exchange_fetch_failures)
+    span.set("trino.exec.exchange-backoff-trips", res.exchange_backoff_trips)
 
 
 def annotate_sync_span(span: "Span", sync) -> None:

@@ -60,9 +60,18 @@ class _Task:
         self.task_id = task_id
         self.state = "RUNNING"
         self.error: Optional[str] = None
+        # spi/errors.py classification of the failure, reported in status
+        # JSON so the coordinator can decide fail-fast vs retry without
+        # parsing message strings
+        self.error_type: Optional[str] = None
+        self.error_code: Optional[str] = None
         self.buffer = None  # OutputBuffer, set when planning completes
         self.ready = threading.Event()
         self.thread: Optional[threading.Thread] = None
+
+    def status_json(self) -> dict:
+        return {"state": self.state, "error": self.error,
+                "error_type": self.error_type, "error_code": self.error_code}
 
 
 class TaskServer:
@@ -142,11 +151,25 @@ class TaskServer:
         return False
 
     def _get(self, h) -> None:
-        parts = [p for p in h.path.split("/") if p]
+        from urllib.parse import parse_qs, urlsplit
+
+        url = urlsplit(h.path)
+        query = parse_qs(url.query)
+        parts = [p for p in url.path.split("/") if p]
         if parts == ["v1", "info"]:
             h._send(200, json.dumps({
                 "state": "SHUTTING_DOWN" if self._draining else "ACTIVE",
                 "tasks": len(self.tasks)}).encode())
+            return
+        if parts == ["v1", "status"]:
+            # the heartbeat target: node state + EVERY task's state in one
+            # payload, so the coordinator sweeps one poll per worker
+            # (failure_detector.py caches this)
+            h._send(200, json.dumps({
+                "state": "SHUTTING_DOWN" if self._draining else "ACTIVE",
+                "tasks": {tid: t.status_json()
+                          for tid, t in list(self.tasks.items())},
+            }).encode())
             return
         if len(parts) == 4 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "status":
@@ -154,22 +177,31 @@ class TaskServer:
             if t is None:
                 h._send(404, b'{"error": "no such task"}')
                 return
-            h._send(200, json.dumps(
-                {"state": t.state, "error": t.error}).encode())
+            h._send(200, json.dumps(t.status_json()).encode())
             return
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results":
             if not self._authorized(h):
                 return
-            self._get_results(h, parts[2], int(parts[4]), int(parts[5]))
+            # ?maxwait= bounds the server-side long-poll so short
+            # non-blocking client polls return promptly (default keeps the
+            # historical 5 s long-poll)
+            try:
+                maxwait = float(query.get("maxwait", ["5.0"])[0])
+            except ValueError:
+                maxwait = 5.0
+            maxwait = min(max(maxwait, 0.0), 5.0)
+            self._get_results(h, parts[2], int(parts[4]), int(parts[5]),
+                              maxwait)
             return
         h._send(404, b'{"error": "not found"}')
 
     def _get_results(self, h, task_id: str, buffer_id: int,
-                     token: int) -> None:
+                     token: int, maxwait: float = 5.0) -> None:
         """Pull-token page read (TaskResource.getResults equivalent): body
         is length-prefixed serde frames; X-Next-Token / X-Done carry the
-        protocol state."""
+        protocol state.  ``maxwait`` bounds both blocking waits so the
+        handler never outlives the client's own poll budget."""
         import struct
 
         t = self.tasks.get(task_id)
@@ -177,13 +209,16 @@ class TaskServer:
             h._send(404, b'{"error": "no such task"}')
             return
         if t.state == "FAILED":
-            h._send(500, json.dumps({"error": t.error}).encode())
+            h._send(500, json.dumps({
+                "error": t.error, "error_type": t.error_type,
+                "error_code": t.error_code}).encode())
             return
-        if not t.ready.wait(timeout=5.0) or t.buffer is None:
+        if not t.ready.wait(timeout=maxwait) or t.buffer is None:
             h._send(200, b"", "application/x-trino-pages",
                     {"X-Next-Token": token, "X-Done": 0})
             return
-        pages, next_token, done = t.buffer.get(buffer_id, token, timeout=1.0)
+        pages, next_token, done = t.buffer.get(
+            buffer_id, token, timeout=min(maxwait, 1.0))
         body = bytearray()
         for p in pages:
             raw = p.data if hasattr(p, "data") else None
@@ -278,7 +313,10 @@ class TaskServer:
             catalog = build_catalog(desc["catalog"])
             fragment = desc["fragment"]
             task_index = desc["task_index"]
-            attempt = desc.get("spool", {}).get("attempt", 0)
+            # streaming descriptors carry the query-retry attempt at the top
+            # level; FTE descriptors keep it inside the spool block
+            attempt = desc.get(
+                "attempt", desc.get("spool", {}).get("attempt", 0))
             rules = desc.get("failure_rules", [])
             if check_wire_rules(rules, PROCESS_EXIT, fragment.id,
                                 task_index, attempt):
@@ -291,6 +329,14 @@ class TaskServer:
                 raise InjectedFailure(
                     f"injected TASK_FAILURE f{fragment.id}.t{task_index} "
                     f"attempt {attempt}")
+            if desc.get("upstream") and check_wire_rules(
+                    rules, GET_RESULTS_FAILURE, fragment.id, task_index,
+                    attempt):
+                # streaming analogue of the FTE spool-read fault: the task's
+                # exchange fetch from its producers fails
+                raise InjectedFailure(
+                    f"injected GET_RESULTS_FAILURE f{fragment.id}."
+                    f"t{task_index} attempt {attempt}")
 
             clients = {}
             if "spool_upstream" in desc and desc["spool_upstream"]:
@@ -308,14 +354,18 @@ class TaskServer:
                     else:
                         clients[src_id] = DurableSpoolClient(
                             info["dirs"], task_index, on_read)
+            backoff_cfg = desc.get("exchange_backoff")
             for src_id, info in desc.get("upstream", {}).items():
                 uris = info["uris"]
                 if info.get("merge"):
                     clients[src_id] = [
-                        HttpExchangeClient([u], task_index) for u in uris
+                        HttpExchangeClient([u], task_index,
+                                           backoff=backoff_cfg)
+                        for u in uris
                     ]
                 else:
-                    clients[src_id] = HttpExchangeClient(uris, task_index)
+                    clients[src_id] = HttpExchangeClient(
+                        uris, task_index, backoff=backoff_cfg)
             planner = LocalPlanner(
                 catalog,
                 splits_per_node=desc.get("splits_per_node", 4),
@@ -346,7 +396,12 @@ class TaskServer:
             run_pipelines(local.pipelines)
             t.state = "FINISHED"
         except BaseException as e:  # noqa: BLE001 — reported to coordinator
+            from ..spi.errors import classify
+
+            te = classify(e)
             t.error = f"{type(e).__name__}: {e}"
+            t.error_type = te.error_type
+            t.error_code = te.code.name
             t.state = "FAILED"
             if t.buffer is not None:
                 t.buffer.abort()
@@ -374,6 +429,12 @@ def main(argv=None) -> None:
             jax.config.update("jax_platforms", plat)
         except Exception:
             pass
+    if os.environ.get("TRINO_TPU_TEST_BOOT_FAIL"):
+        # deterministic boot-failure hook for WorkerProcess boot-timeout
+        # tests: die with a diagnostic BEFORE printing LISTENING
+        print("TRINO_TPU_TEST_BOOT_FAIL: injected boot failure",
+              file=sys.stderr, flush=True)
+        sys.exit(3)
     server = TaskServer(args.port)
     print(f"LISTENING {server.port}", flush=True)
     server.serve_forever()

@@ -86,6 +86,10 @@ SETTABLE_SESSION_PROPERTIES = {
     "query_max_queued", "scale_writers", "writer_task_limit",
     "task_concurrency", "fte_speculative", "fte_speculative_delay_s",
     "fte_memory_growth",
+    "query_retry_attempts", "retry_initial_delay_s", "retry_max_delay_s",
+    "heartbeat_interval_s", "heartbeat_failure_threshold",
+    "max_worker_replacements", "exchange_backoff_min_s",
+    "exchange_backoff_max_s", "exchange_max_failure_duration_s",
 }
 
 
@@ -361,9 +365,32 @@ class Session:
     # serialize exchange pages to compressed wire bytes (network mode)
     exchange_serde: bool = False
     # NONE = streaming pipelined scheduler; TASK = fault-tolerant execution
-    # (stage-by-stage spooled exchange + per-task retry)
+    # (stage-by-stage spooled exchange + per-task retry); QUERY = streaming
+    # scheduler with coordinator query-level retry — on a retryable
+    # (non-USER) failure the whole subplan re-runs with the implicated
+    # worker blacklisted (reference: coordinator query retries keep the
+    # pipelined overlap; recovery unit is the query)
     retry_policy: str = "NONE"
     task_retry_attempts: int = 2
+    # retry_policy=QUERY knobs: attempt budget and the deterministic
+    # exponential backoff between re-runs (spi/errors.py Backoff)
+    query_retry_attempts: int = 2
+    retry_initial_delay_s: float = 0.1
+    retry_max_delay_s: float = 2.0
+    # heartbeat failure detection over worker /v1/status
+    # (execution/failure_detector.py): sweep cadence and how many
+    # consecutive probe misses declare a worker GONE
+    heartbeat_interval_s: float = 0.5
+    heartbeat_failure_threshold: int = 3
+    # how many GONE workers the runner may respawn over its lifetime
+    # (0 = never replace; capacity shrinks instead)
+    max_worker_replacements: int = 2
+    # per-source exchange backoff (HttpExchangeClient): delay bounds and the
+    # failure-duration budget after which an unreachable producer surfaces
+    # as a classified EXTERNAL failure instead of a silent stall
+    exchange_backoff_min_s: float = 0.05
+    exchange_backoff_max_s: float = 2.0
+    exchange_max_failure_duration_s: float = 120.0
     # intra-task parallelism: concurrent source drivers per pipeline over a
     # local gather exchange (reference: LocalExchange.java:67 +
     # AddLocalExchanges.java:111; task_concurrency session property)

@@ -40,3 +40,15 @@ from .connector import (  # noqa: F401
     TableSchema,
     TableStatistics,
 )
+from .errors import (  # noqa: F401
+    EXTERNAL,
+    INSUFFICIENT_RESOURCES,
+    INTERNAL,
+    USER,
+    Backoff,
+    ErrorCode,
+    TrinoError,
+    classify,
+    is_retryable_type,
+    lookup_code,
+)

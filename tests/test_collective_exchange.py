@@ -18,6 +18,14 @@ TABLES = ["nation", "region", "supplier", "customer", "part", "partsupp",
           "orders", "lineitem"]
 
 
+@pytest.fixture(autouse=True)
+def _legacy_collective_path(monkeypatch):
+    """This module tests the legacy collective-exchange path; whole-stage
+    compilation (which subsumes these edges) has its own suite in
+    tests/test_fused_stage.py."""
+    monkeypatch.setenv("TRINO_TPU_FUSED_STAGE", "0")
+
+
 @pytest.fixture(scope="module")
 def harness():
     catalog = default_catalog(scale_factor=0.01)

@@ -41,6 +41,11 @@ PATTERNS: list[tuple[re.Pattern, str]] = [
 # parallel/ rides along: static_agg and the shard_map pipelines promise
 # sync-free bodies, so raw fetches there are as load-bearing a bug as in exec
 SCAN_DIRS = ("trino_tpu/exec", "trino_tpu/ops", "trino_tpu/parallel")
+# the fused-stage path promises ZERO host syncs between input deposit and
+# output take (SyncGuard hot_region asserted by tests/test_fused_stage.py),
+# and the collective exchange is its legacy twin — both scan file-by-file
+SCAN_FILES = ("trino_tpu/execution/stage_compiler.py",
+              "trino_tpu/execution/collective_exchange.py")
 EXEMPT_FILES = ("syncguard.py",)  # the sanctioned wrapper itself
 PRAGMA = "sync-ok"
 
@@ -66,6 +71,10 @@ def run(root: str) -> list[tuple[str, int, str, str]]:
                 if not fn.endswith(".py") or fn in EXEMPT_FILES:
                     continue
                 findings.extend(lint_file(os.path.join(dirpath, fn)))
+    for rel in SCAN_FILES:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            findings.extend(lint_file(path))
     return findings
 
 

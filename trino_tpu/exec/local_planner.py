@@ -263,6 +263,20 @@ class LocalPlanner:
             return chain
 
         if isinstance(node, P.Aggregate):
+            if (node.step == "FINAL"
+                    and isinstance(node.source, P.RemoteSource)):
+                from ..execution.stage_compiler import (
+                    FusedStageExec,
+                    FusedStageSourceOperator,
+                )
+
+                client = self.remote_clients.get(node.source.fragment_id)
+                if isinstance(client, FusedStageExec):
+                    # whole-stage compilation: the producer stage already
+                    # ran PARTIAL + all_to_all + FINAL inside one jitted
+                    # program; this pipeline just takes its device shard
+                    return [FusedStageSourceOperator(client,
+                                                     self.task_index)]
             chain = self._chain(node.source)
             chain.append(HashAggregationOperator(
                 node.group_keys, node.aggregates,

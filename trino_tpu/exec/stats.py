@@ -166,6 +166,47 @@ class ResilienceStats:
 
 
 @dataclass
+class FusedStageStats:
+    """Counters for whole-stage GSPMD compilation (execution/stage_compiler.py):
+    how many batches the fused accumulate program absorbed, how often the
+    shape-bucket cache hit vs traced, and how many seam merges / legacy
+    fallbacks ran.  One instance per FusedStageExec; ``merge`` folds the
+    per-sink instances into the query-level roll-up."""
+
+    stages: int = 0            # fused stage seams that executed
+    compiles: int = 0          # distinct (program, bucket) traces
+    cache_hits: int = 0        # jitted calls served by an existing trace
+    jit_calls: int = 0         # accumulate-program dispatches (one per batch)
+    batches: int = 0           # input batches absorbed
+    input_rows: int = 0        # physical rows (padded slots included)
+    merges: int = 0            # seam merge programs executed (one per stage)
+    fallbacks: int = 0         # overflow -> legacy per-operator re-runs
+
+    def merge(self, other: "FusedStageStats") -> None:
+        self.stages += other.stages
+        self.compiles += other.compiles
+        self.cache_hits += other.cache_hits
+        self.jit_calls += other.jit_calls
+        self.batches += other.batches
+        self.input_rows += other.input_rows
+        self.merges += other.merges
+        self.fallbacks += other.fallbacks
+
+    @property
+    def any(self) -> bool:
+        return any((self.stages, self.jit_calls, self.batches,
+                    self.merges, self.fallbacks))
+
+    def text(self) -> str:
+        return (
+            f"fused: {self.stages} stages, {self.batches} batches "
+            f"({self.input_rows} rows) in {self.jit_calls} jit calls, "
+            f"{self.compiles} compiles / {self.cache_hits} cache hits, "
+            f"{self.merges} seam merges, {self.fallbacks} fallbacks"
+        )
+
+
+@dataclass
 class OperatorStats:
     name: str
     input_rows: int = 0
@@ -189,11 +230,17 @@ class QueryStats:
     scan: ScanIngestStats | None = None
     sync: "object | None" = None  # syncguard.SyncStats delta for this query
     resilience: ResilienceStats | None = None  # retry/heartbeat delta
+    fused: FusedStageStats | None = None  # whole-stage compilation counters
 
     def merge_scan(self, ingest: ScanIngestStats) -> None:
         if self.scan is None:
             self.scan = ScanIngestStats()
         self.scan.merge(ingest)
+
+    def merge_fused(self, fused: FusedStageStats) -> None:
+        if self.fused is None:
+            self.fused = FusedStageStats()
+        self.fused.merge(fused)
 
     def merge_sync(self, sync) -> None:
         if self.sync is None:
@@ -212,6 +259,8 @@ class QueryStats:
             lines.append("  " + self.sync.text())
         if self.resilience is not None and self.resilience.any:
             lines.append("  " + self.resilience.text())
+        if self.fused is not None and self.fused.any:
+            lines.append("  " + self.fused.text())
         for i, p in enumerate(self.pipelines):
             lines.append(f"  pipeline {i}:")
             for op in p.operators:

@@ -31,8 +31,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..exec import kernels as K
+from ..exec import syncguard as SG
 from ..exec.operators import Operator, _concat_device
 from ..spi.batch import Column, ColumnBatch, unify_dictionaries
+from ..spi.errors import PAGE_TRANSPORT_TIMEOUT, TrinoError
 
 __all__ = ["CollectiveRepartitionExchange", "CollectiveOutputSink",
            "CollectiveSourceOperator", "collectives_available"]
@@ -362,7 +364,8 @@ class CollectiveRepartitionExchange:
         if tiled:
             # stage 1 out: dest-sorted columns + per-destination counts;
             # ONE small pull picks the tile, then stage 2 moves the rows
-            counts = np.asarray(jax.device_get(outs[-1])).reshape(n, n)
+            counts = np.asarray(
+                SG.fetch(outs[-1], "exchange.tile-counts")).reshape(n, n)
             tile = K.bucket(max(int(counts.max()), 1))
             _, prog2 = _tiled_all_to_all_program(
                 n, len(self.types), valid_flags, cap, tile)
@@ -393,9 +396,22 @@ class CollectiveRepartitionExchange:
                                            live_shards[i])
 
     # ----------------------------------------------------------- consumers
-    def take(self, task_index: int, timeout: float = 600.0) -> ColumnBatch:
+    def take(self, task_index: int,
+             timeout: Optional[float] = None) -> ColumnBatch:
+        """Blocking take under the PR-5 timeout policy: the default comes
+        from TRINO_TPU_EXCHANGE_STALL_S (execution/task.py) instead of a
+        hard-coded constant, and a stall raises a *retryable*
+        PAGE_TRANSPORT_TIMEOUT — the same contract the HTTP exchange client
+        carries, so retry_policy=QUERY treats a wedged collective exactly
+        like a wedged page transport."""
+        if timeout is None:
+            from .task import STALL_TIMEOUT_S
+
+            timeout = STALL_TIMEOUT_S
         if not self._done.wait(timeout):
-            raise TimeoutError("collective exchange stalled")
+            raise TrinoError(
+                PAGE_TRANSPORT_TIMEOUT,
+                f"collective exchange stalled after {timeout:.0f}s")
         if self._error is not None:
             raise RuntimeError(
                 f"collective exchange failed: {self._error}") from self._error

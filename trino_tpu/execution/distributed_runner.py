@@ -92,6 +92,9 @@ class DistributedQueryRunner:
 
         self.resilience = ResilienceStats()
         self.resilience_events: list = []
+        # cumulative count of fused-stage overflow fallbacks (whole-stage
+        # compilation re-running a subplan on the legacy per-operator path)
+        self.fused_fallbacks = 0
 
     # ------------------------------------------------------------------ plan
     def create_plan(self, sql: str) -> PlanNode:
@@ -253,11 +256,13 @@ class DistributedQueryRunner:
 
     def _run_streaming(self, subplan: SubPlan, stats_sink: Optional[list],
                        attempt: int = 0,
-                       blacklist: frozenset = frozenset()) -> QueryResult:
+                       blacklist: frozenset = frozenset(),
+                       use_fused: bool = True) -> QueryResult:
         from .collective_exchange import (
             CollectiveRepartitionExchange,
             collectives_available,
         )
+        from .stage_compiler import FusedStageOverflow, plan_fused_stages
 
         fragments = subplan.all_fragments()
         task_counts, consumer_tasks = self.stage_task_counts(fragments)
@@ -285,6 +290,14 @@ class DistributedQueryRunner:
                 for _ in range(tc)
             ]
 
+        # whole-stage compilation (execution/stage_compiler.py): fragmenter-
+        # marked PARTIAL->shuffle->FINAL seams run as one jitted program per
+        # batch-bucket plus one seam merge; the collective exchange and the
+        # host buffers cover every remaining edge
+        fused_edges: dict = {}
+        if use_fused:
+            fused_edges = plan_fused_stages(
+                fragments, self.session, task_counts, consumer_tasks)
         # device-collective REPARTITION edges (all_to_all over the mesh)
         # where producer/consumer task counts line up; host buffers remain
         # the fallback for every other edge
@@ -292,20 +305,23 @@ class DistributedQueryRunner:
         if self.session.use_collectives:
             for f in fragments:
                 tc = stages[f.id].task_count
-                if (f.output_kind == "REPARTITION"
+                if (f.id not in fused_edges
+                        and f.output_kind == "REPARTITION"
                         and consumer_tasks.get(f.id) == tc
                         and collectives_available(tc)):
                     collective_edges[f.id] = CollectiveRepartitionExchange(
                         tc, f.output_keys,
                         f.root.output_names, f.root.output_types)
-        # kept as an attribute for observability/tests; tasks receive the
+        # kept as attributes for observability/tests; tasks receive the
         # dict as an argument so concurrent queries cannot cross-wire
         self._collective_edges = collective_edges
+        self._fused_edges = fused_edges
+        edges = {**collective_edges, **fused_edges}
 
         errors: list[BaseException] = []
         if self.session.task_scheduler == "TIME_SHARING":
             hung = self._run_time_sharing(
-                fragments, stages, errors, stats_sink, collective_edges,
+                fragments, stages, errors, stats_sink, edges,
                 attempt)
         else:
             threads: list[threading.Thread] = []
@@ -315,7 +331,7 @@ class DistributedQueryRunner:
                     th = threading.Thread(
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
-                              collective_edges, attempt),
+                              edges, attempt),
                         name=f"task-{f.id}.{t}",
                         daemon=True,
                     )
@@ -331,11 +347,41 @@ class DistributedQueryRunner:
             for s in stages.values():
                 for b in s.buffers:
                     b.abort()
-            for ex in collective_edges.values():
+            for ex in edges.values():
                 ex.abort()
             if errors:
+                if use_fused and any(isinstance(e, FusedStageOverflow)
+                                     for e in errors):
+                    # a task saw more groups than the fused state cap: the
+                    # legacy per-operator path has no such limit — re-run
+                    # this subplan on it (FusedStageStats.fallbacks surfaces
+                    # the event; raise TRINO_TPU_FUSED_CAP to avoid it)
+                    self.fused_fallbacks += 1
+                    if stats_sink is not None:
+                        from ..exec.stats import FusedStageStats
+
+                        stats_sink.append(QueryStats(
+                            label="fused stages:",
+                            fused=FusedStageStats(fallbacks=1)))
+                    return self._run_streaming(subplan, stats_sink, attempt,
+                                               blacklist, use_fused=False)
                 raise errors[0]
             raise TimeoutError(f"tasks did not complete: {hung}")
+
+        if fused_edges:
+            from ..exec.stats import FusedStageStats
+
+            from .tracing import annotate_fused_span
+
+            roll = FusedStageStats()
+            for ex in fused_edges.values():
+                roll.merge(ex.stats)
+            span = self.tracer.current()
+            if span is not None:
+                annotate_fused_span(span, roll)
+            if stats_sink is not None:
+                stats_sink.append(QueryStats(label="fused stages:",
+                                             fused=roll))
 
         # drain the root stage's buffer as the client
         from .task import maybe_deserialize
@@ -501,13 +547,23 @@ class DistributedQueryRunner:
             hbm_limit_bytes=self.session.hbm_limit_bytes,
             task_concurrency=self.session.task_concurrency,
         )
-        local = planner.plan(f.root)
-        # swap the collector for the task's output sink
-        if f.id in collective:
+        # swap the collector for the task's output sink; a fused producer
+        # fragment plans only its FEED subtree — the Filter/Project chain,
+        # the PARTIAL aggregation and the seam shuffle run inside the fused
+        # sink's jitted programs (execution/stage_compiler.py)
+        from .stage_compiler import FusedStageExec, FusedStageSinkOperator
+
+        ex = collective.get(f.id)
+        if isinstance(ex, FusedStageExec):
+            local = planner.plan(ex.spec.feed)
+            sink = FusedStageSinkOperator(ex, task_index)
+        elif ex is not None:
             from .collective_exchange import CollectiveOutputSink
 
-            sink = CollectiveOutputSink(collective[f.id], task_index)
+            local = planner.plan(f.root)
+            sink = CollectiveOutputSink(ex, task_index)
         else:
+            local = planner.plan(f.root)
             sink = PartitionedOutputSink(
                 stage.buffers[task_index],
                 f.output_kind if f.output_kind != "OUTPUT" else "GATHER",

@@ -11,17 +11,53 @@ consumer / coordinator stage).
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
+from typing import Optional
 
 from ..planner.plan import (
+    Aggregate,
     Exchange,
+    Filter,
+    MatchRecognize,
     PlanNode,
+    Project,
     RemoteSource,
+    TableFunctionScan,
     TableScan,
+    TableWriter,
     Union,
     plan_text,
 )
+from ..sql.ir import InputRef, referenced_inputs
 
-__all__ = ["PlanFragment", "SubPlan", "fragment_plan"]
+__all__ = ["PlanFragment", "SubPlan", "FusedSeam", "fragment_plan",
+           "mark_device_residency"]
+
+# Aggregate functions whose PARTIAL state merges with plain
+# sum/min/max combines inside one jitted program (avg rides as its
+# sum+count expansion from add_exchanges.partial_agg_layout).  distinct
+# and STAT_AGGS never reach a PARTIAL/FINAL split with these fns.
+_FUSABLE_AGGS = frozenset({"count", "sum", "min", "max", "avg"})
+
+# Plan nodes whose operators keep batches host-side (Python row loops or
+# connector writes); any fragment containing one is not device-resident.
+_HOST_NODES = (MatchRecognize, TableFunctionScan, TableWriter)
+
+
+@dataclass(frozen=True)
+class FusedSeam:
+    """A REPARTITION edge eligible for whole-stage compilation: the
+    producer's PARTIAL aggregation, the all_to_all shuffle and the
+    consumer's FINAL aggregation compile into ONE jitted program
+    (execution/stage_compiler.py).  ``in_spec``/``out_spec`` record the
+    seam PartitionSpec contract: both sides shard dim 0 over the mesh
+    axis, so the fused program needs no resharding at the boundary."""
+
+    producer_fid: int
+    consumer_fid: int
+    nk: int                    # number of group-key columns
+    axis: str = "x"            # mesh axis name (matches collective_exchange)
+    in_spec: tuple = ("x",)    # producer deposit sharding, dim 0
+    out_spec: tuple = ("x",)   # consumer take sharding, dim 0
 
 
 @dataclass
@@ -32,6 +68,9 @@ class PlanFragment:
     output_kind: str           # GATHER | REPARTITION | BROADCAST | OUTPUT
     output_keys: tuple[int, ...]
     source_fragments: list[int]
+    device_resident: bool = False   # every operator keeps batches on device
+    fused_seam: Optional[FusedSeam] = None  # set when this fragment's
+    #                                 REPARTITION edge is whole-stage fusable
 
 
 @dataclass
@@ -52,7 +91,11 @@ class SubPlan:
             lines.append(
                 f"Fragment {f.id} [{f.partitioning} -> {f.output_kind}"
                 + (f" keys={list(f.output_keys)}" if f.output_keys else "")
-                + f" sources={f.source_fragments}]")
+                + f" sources={f.source_fragments}"
+                + (" device-resident" if f.device_resident else "")
+                + (f" fused-seam->f{f.fused_seam.consumer_fid}"
+                   if f.fused_seam is not None else "")
+                + "]")
             lines.append(plan_text(f.root, 1))
         return "\n".join(lines)
 
@@ -122,6 +165,96 @@ class _Fragmenter:
         return "SINGLE"
 
 
+def _walk(node: PlanNode):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
+
+
+def _dict_free(expr, in_types) -> bool:
+    """True when ``expr`` reads no dictionary-encoded channel (the fused
+    accumulate program evaluates expressions on raw lanes; dictionary
+    columns may only pass through as bare InputRefs)."""
+    return not any(in_types[i].is_dictionary_encoded
+                   for i in referenced_inputs(expr))
+
+
+def _match_fused_seam(producer: PlanFragment,
+                      consumer: PlanFragment) -> Optional[FusedSeam]:
+    """Structural eligibility of one REPARTITION edge for whole-stage
+    compilation: producer root is ``Aggregate(PARTIAL)`` over a
+    Filter/Project chain, consumer FINAL-aggregates exactly this edge,
+    aggregate states merge with plain sum/min/max combines, and every
+    fused expression reads only non-dictionary channels."""
+    root = producer.root
+    if producer.output_kind != "REPARTITION":
+        return None
+    if not isinstance(root, Aggregate) or root.step != "PARTIAL":
+        return None
+    nk = len(root.group_keys)
+    if nk == 0 or producer.output_keys != tuple(range(nk)):
+        return None
+    if any(a.distinct or a.fn not in _FUSABLE_AGGS for a in root.aggregates):
+        return None
+    src_types = root.source.output_types
+    for a in root.aggregates:
+        # agg args must be plain numeric lanes (covers long decimals:
+        # precision > 18 is dictionary/limb-encoded)
+        if a.arg >= 0 and src_types[a.arg].is_dictionary_encoded:
+            return None
+    node = root.source
+    while isinstance(node, (Filter, Project)):
+        in_types = node.source.output_types
+        if isinstance(node, Filter):
+            if not _dict_free(node.predicate, in_types):
+                return None
+        else:
+            for e in node.expressions:
+                if not isinstance(e, InputRef) and not _dict_free(e, in_types):
+                    return None
+        node = node.source
+    # the consumer must FINAL-aggregate this edge, and reference it only there
+    finals = [n for n in _walk(consumer.root)
+              if isinstance(n, Aggregate) and n.step == "FINAL"
+              and isinstance(n.source, RemoteSource)
+              and n.source.fragment_id == producer.id]
+    remotes = [n for n in _walk(consumer.root)
+               if isinstance(n, RemoteSource)
+               and n.fragment_id == producer.id]
+    if len(finals) != 1 or len(remotes) != 1:
+        return None
+    fin = finals[0]
+    if fin.group_keys != tuple(range(nk)) or len(fin.aggregates) != len(root.aggregates):
+        return None
+    if any(fa.fn != pa.fn for fa, pa in zip(fin.aggregates, root.aggregates)):
+        return None
+    return FusedSeam(producer.id, consumer.id, nk)
+
+
+def mark_device_residency(subplan: SubPlan) -> SubPlan:
+    """Bottom-up TPU-residency propagation + fused-seam recording.
+
+    A fragment is device-resident when none of its own nodes run host-side
+    loops and all of its source fragments are device-resident; on every
+    device-resident REPARTITION producer whose consumer FINAL-aggregates
+    it, record the FusedSeam that stage_compiler.py compiles into one
+    jitted program."""
+    frags = {f.id: f for f in subplan.all_fragments()}
+    for f in subplan.all_fragments():  # children first
+        own = not any(isinstance(n, _HOST_NODES) for n in _walk(f.root))
+        f.device_resident = own and all(
+            frags[s].device_resident for s in f.source_fragments)
+    for consumer in frags.values():
+        for src in consumer.source_fragments:
+            producer = frags[src]
+            if not producer.device_resident:
+                continue
+            seam = _match_fused_seam(producer, consumer)
+            if seam is not None:
+                producer.fused_seam = seam
+    return subplan
+
+
 def fragment_plan(root: PlanNode) -> SubPlan:
     """Root fragment is the coordinator (OUTPUT) stage."""
-    return _Fragmenter().fragment(root, "OUTPUT", ())
+    return mark_device_residency(_Fragmenter().fragment(root, "OUTPUT", ()))

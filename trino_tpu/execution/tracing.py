@@ -15,7 +15,23 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 __all__ = ["Span", "Tracer", "annotate_scan_span", "annotate_sync_span",
-           "annotate_resilience_span"]
+           "annotate_resilience_span", "annotate_fused_span"]
+
+
+def annotate_fused_span(span: "Span", fs) -> None:
+    """Set the ``trino.fused.*`` attributes from a FusedStageStats roll-up
+    (exec/stats.py): whole-stage compile counts, shape-bucket cache hits and
+    per-batch dispatch counts next to the query wall time."""
+    if fs is None or not fs.any:
+        return
+    span.set("trino.fused.stages", fs.stages)
+    span.set("trino.fused.batches", fs.batches)
+    span.set("trino.fused.input-rows", fs.input_rows)
+    span.set("trino.fused.jit-calls", fs.jit_calls)
+    span.set("trino.fused.compiles", fs.compiles)
+    span.set("trino.fused.cache-hits", fs.cache_hits)
+    span.set("trino.fused.seam-merges", fs.merges)
+    span.set("trino.fused.fallbacks", fs.fallbacks)
 
 
 def annotate_resilience_span(span: "Span", res) -> None:

@@ -753,7 +753,9 @@ def main() -> None:
         else:
             print(f"baseline failed:\n{proc.stderr[-2000:]}", file=sys.stderr)
 
-    print(json.dumps({
+    from trino_tpu.telemetry.metrics import REGISTRY
+
+    result = {
         "metric": f"tpch_q1_q3_engine_sf{sf:g}_input_rows_per_sec",
         "value": round(rows_per_sec),
         "unit": "rows/s",
@@ -769,7 +771,15 @@ def main() -> None:
         "blocking_syncs": sync.blocking_syncs,
         "hot_loop_syncs": sync.hot_loop_syncs,
         "expand_overflows": sync.expand_overflows,
-    }))
+        # full process-wide metrics registry (telemetry/metrics.py): the
+        # same snapshot /v1/metrics serves, archived with the bench run
+        "metrics": REGISTRY.snapshot(),
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_r07.json"), "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,72 @@
+"""Tier-1 wiring for tools/lint_metric_names.py: every metric registration
+in the tree carries a Prometheus-legal, ``trino_``-prefixed name (counters
+end in ``_total``) and no name literal is registered at two sites."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(ROOT, "tools", "lint_metric_names.py")
+
+
+def _mod():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import lint_metric_names as L
+    finally:
+        sys.path.pop(0)
+    return L
+
+
+def test_metric_names_lint_clean():
+    proc = subprocess.run([sys.executable, LINT], capture_output=True,
+                          text=True, timeout=60)
+    assert proc.returncode == 0, \
+        f"metric naming violations:\n{proc.stdout}\n{proc.stderr}"
+
+
+def test_lint_catches_planted_violations(tmp_path):
+    """The lint actually fires (guards against pattern rot)."""
+    L = _mod()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'a = REGISTRY.counter("trino_good_total", "fine")\n'
+        'b = REGISTRY.counter("scan_bytes_total", "no prefix")\n'
+        'c = REGISTRY.counter("trino_scan_bytes", "no _total")\n'
+        'd = REGISTRY.gauge("trino_bad-name", "illegal char")\n'
+        'e = REGISTRY.gauge("nope", "exempt")  # metric-ok: test pragma\n')
+    findings = L.lint_file(str(bad))
+    assert len(findings) == 3  # good line + pragma line pass
+    problems = {f[3] for f in findings}
+    assert any("prefix" in p for p in problems)
+    assert any("_total" in p for p in problems)
+    assert any("illegal" in p for p in problems)
+
+
+def test_lint_catches_duplicate_registration(tmp_path):
+    L = _mod()
+    pkg = tmp_path / "trino_tpu"
+    pkg.mkdir()
+    (pkg / "one.py").write_text(
+        'a = REGISTRY.counter("trino_dup_total", "first")\n')
+    (pkg / "two.py").write_text(
+        'b = REGISTRY.counter("trino_dup_total", "second")\n')
+    findings = L.run(str(tmp_path))
+    assert len(findings) == 1
+    assert "duplicate registration" in findings[0][3]
+
+
+def test_real_registry_agrees_with_lint():
+    """The lint's naming rules are the registry's own: names the lint
+    rejects are names the registry raises on."""
+    from trino_tpu.telemetry.metrics import MetricsRegistry
+
+    import pytest
+
+    r = MetricsRegistry()
+    for bad, kind in [("scan_bytes_total", "counter"),
+                      ("trino_scan_bytes", "counter"),
+                      ("trino_bad-name", "gauge")]:
+        with pytest.raises(ValueError):
+            getattr(r, kind)(bad, "help")

@@ -144,3 +144,27 @@ def test_internal_secret_required(runners):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req, timeout=10)
     assert ei.value.code == 401
+
+
+def test_cross_process_trace_tree(runners):
+    """The coordinator's query span contains the worker task spans shipped
+    back over HTTP: remote-parented via the traceparent header on task
+    create, serialized with task completion, re-attached as one tree."""
+    dist, _ = runners
+    dist.execute("select count(*) from nation")
+    root = dist.tracer.finished[-1]
+    assert root.name == "trino.query"
+    tasks = [c for c in root.children if c.name == "trino.task"]
+    assert tasks, "no remote task spans re-attached under the query span"
+    for t in tasks:
+        assert t.trace_id == root.trace_id
+        assert t.parent_id == root.span_id
+        assert t.attributes["trino.task.worker"].startswith("127.0.0.1:")
+    scanned = sum(t.attributes.get("trino.scan.rows", 0) for t in tasks)
+    assert scanned == 25
+    # the /v1/metrics scrape on a live worker shows its own task counters
+    import urllib.request
+
+    url = dist.workers[0].url
+    body = urllib.request.urlopen(f"{url}/v1/metrics").read().decode()
+    assert "trino_tasks_created_total" in body

@@ -237,7 +237,7 @@ def test_fetch_honors_caller_poll_timeout(monkeypatch):
         def __exit__(self, *a):
             return False
 
-    def fake_http(method, url, data=None, timeout=30.0):
+    def fake_http(method, url, data=None, timeout=30.0, headers=None):
         captured.append((url, timeout))
         return FakeResp()
 

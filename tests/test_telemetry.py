@@ -1,0 +1,282 @@
+"""PR 7 observability surface: the metrics registry (telemetry/metrics.py),
+the /v1/metrics Prometheus endpoints on coordinator and worker, distributed
+trace assembly (coordinator-rooted query span containing worker task spans),
+the ``system`` catalog's runtime/metrics tables, and the enriched
+QueryCompletedEvent."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.telemetry import metrics as tm
+from trino_tpu.telemetry.metrics import MetricsRegistry
+
+
+# ------------------------------------------------------------ registry units
+
+
+def test_counter_thread_local_cells_fold():
+    r = MetricsRegistry()
+    c = r.counter("trino_things_total", "things")
+    c.inc()
+    c.inc(4)
+
+    def work():
+        for _ in range(100):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # dead threads' cells fold into the retired total; value() is exact
+    assert c.value() == 805
+    assert c.value() == 805  # folding is idempotent
+
+
+def test_distribution_percentiles_and_merge():
+    r = MetricsRegistry()
+    d = r.distribution("trino_lat_seconds", "latency", lo=1e-3)
+    for ms in range(1, 101):  # 1ms..100ms
+        d.record(ms / 1e3)
+    snap = d.snapshot()
+    assert snap["count"] == 100
+    assert abs(snap["sum"] - sum(ms / 1e3 for ms in range(1, 101))) < 1e-9
+    # log-spaced buckets: percentiles are interpolated, so allow 2x slack
+    assert 0.02 < snap["p50"] < 0.1
+    assert snap["p50"] <= snap["p90"] <= snap["p99"]
+    assert snap["p99"] <= snap["max"] + 1e-12
+
+    # cross-process merge: a second registry's snapshot folds in
+    r2 = MetricsRegistry()
+    d2 = r2.distribution("trino_lat_seconds", "latency", lo=1e-3)
+    for _ in range(50):
+        d2.record(0.5)
+    d.merge(d2.snapshot())
+    snap = d.snapshot()
+    assert snap["count"] == 150
+    assert snap["max"] >= 0.5
+
+
+def test_registry_kind_conflict_raises():
+    r = MetricsRegistry()
+    r.counter("trino_x_total", "x")
+    with pytest.raises(ValueError):
+        r.gauge("trino_x_total", "x as gauge")
+
+
+def test_prometheus_render_shape():
+    r = MetricsRegistry()
+    r.counter("trino_c_total", "a counter").inc(3)
+    r.gauge("trino_g", "a gauge").set(7.5)
+    d = r.distribution("trino_h_seconds", "a histogram")
+    d.record(0.01)
+    text = r.render_prometheus()
+    assert "# HELP trino_c_total a counter" in text
+    assert "# TYPE trino_c_total counter" in text
+    assert "trino_c_total 3" in text
+    assert "trino_g 7.5" in text
+    assert "# TYPE trino_h_seconds histogram" in text
+    assert 'trino_h_seconds_bucket{le="+Inf"} 1' in text
+    assert "trino_h_seconds_count 1" in text
+
+
+def test_traceparent_roundtrip():
+    from trino_tpu.execution.tracing import (
+        Span,
+        parse_traceparent,
+        traceparent,
+    )
+
+    s = Span("trino.query")
+    header = traceparent(s)
+    got = parse_traceparent(header)
+    assert got == (s.trace_id, s.span_id)
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("junk") is None
+    assert parse_traceparent("00-short-id-01") is None
+
+
+def test_span_dict_roundtrip_preserves_tree():
+    from trino_tpu.execution.tracing import Span
+
+    root = Span("trino.task", {"trino.scan.rows": 25},
+                trace_id="t" * 32, span_id="a" * 16, parent_id="b" * 16)
+    root.end = root.start + 0.5
+    child = Span("trino.operator", trace_id="t" * 32, span_id="c" * 16,
+                 parent_id="a" * 16)
+    child.end = child.start + 0.1
+    root.children.append(child)
+    back = Span.from_dict(root.to_dict())
+    assert back.name == "trino.task"
+    assert back.attributes["trino.scan.rows"] == 25
+    assert back.trace_id == root.trace_id
+    assert back.parent_id == root.parent_id
+    assert len(back.children) == 1
+    assert back.children[0].parent_id == back.span_id
+    assert abs(back.duration_ms - 500) < 1.0
+
+
+# --------------------------------------------------- /v1/metrics endpoints
+
+
+def test_coordinator_metrics_endpoint():
+    from trino_tpu.server import TrinoTpuServer
+
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.001))
+    runner.execute("select count(*) from nation")
+    srv = TrinoTpuServer(runner, port=0).start()
+    try:
+        host, port = srv.address
+        resp = urllib.request.urlopen(f"http://{host}:{port}/v1/metrics")
+        body = resp.read().decode()
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        # scan, resilience and fused counters are all pre-registered
+        for name in ("trino_scan_bytes_total",
+                     "trino_resilience_query_retries_total",
+                     "trino_fused_compiles_total",
+                     "trino_queries_started_total"):
+            assert name in body, name
+        # the query above actually moved the scan counter
+        line = [ln for ln in body.splitlines()
+                if ln.startswith("trino_scan_bytes_total")][0]
+        assert float(line.split()[-1]) > 0
+    finally:
+        srv.stop()
+
+
+def test_worker_metrics_endpoint():
+    from trino_tpu.execution.worker import TaskServer
+
+    s = TaskServer(0)
+    th = threading.Thread(target=s.serve_forever, daemon=True)
+    th.start()
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{s.port}/v1/metrics")
+        body = resp.read().decode()
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        for name in ("trino_scan_bytes_total", "trino_tasks_created_total",
+                     "trino_exchange_bytes_total"):
+            assert name in body, name
+    finally:
+        s.httpd.shutdown()
+
+
+# ------------------------------------------- distributed trace assembly
+
+
+def test_distributed_query_single_trace_tree():
+    """Satellite 3: a 2-worker distributed query yields ONE root span whose
+    descendants include the worker task spans, with parent/child linkage
+    and the trino.scan.* attributes intact."""
+    d = DistributedQueryRunner(worker_count=2)
+    r = d.execute("select count(*) from nation")
+    assert r.rows() == [(25,)]
+    root = d.tracer.finished[-1]
+    assert root.name == "trino.query"
+    assert root.trace_id and root.span_id
+    tasks = [c for c in root.children if c.name == "trino.task"]
+    assert tasks, "no worker task spans under the query span"
+    for t in tasks:
+        assert t.trace_id == root.trace_id
+        assert t.parent_id == root.span_id
+    scan_rows = sum(t.attributes.get("trino.scan.rows", 0) for t in tasks)
+    assert scan_rows == 25
+    # renderable as one tree
+    text = root.text()
+    assert "trino.query" in text and "trino.task" in text
+
+
+def test_task_spans_not_duplicated_as_roots():
+    """Cross-thread-parented task spans live ONLY in the query tree — they
+    must not also surface as separate roots in tracer.finished."""
+    d = DistributedQueryRunner(worker_count=2)
+    d.execute("select count(*) from region")
+    names = [s.name for s in d.tracer.finished]
+    assert "trino.task" not in names
+
+
+# -------------------------------------------------------- system catalog
+
+
+def test_system_runtime_queries_sql():
+    d = DistributedQueryRunner(worker_count=2)
+    d.execute("select count(*) from nation")
+    r = d.execute("select query_id, state from system.runtime.queries")
+    rows = r.rows()
+    assert any(state == "FINISHED" for _qid, state in rows)
+    # the introspection query itself shows up as RUNNING
+    assert any(state == "RUNNING" for _qid, state in rows)
+
+
+def test_system_runtime_tasks_sql():
+    d = DistributedQueryRunner(worker_count=2)
+    d.execute("select count(*) from nation")
+    r = d.execute("select worker, state from system.runtime.tasks")
+    rows = r.rows()
+    assert rows and all(w == "local" for w, _ in rows)
+    assert any(state == "FINISHED" for _, state in rows)
+
+
+def test_system_metrics_counters_sql():
+    d = DistributedQueryRunner(worker_count=2)
+    d.execute("select count(*) from nation")
+    r = d.execute("select name, kind, value from system.metrics.counters")
+    by_name = {name: (kind, value) for name, kind, value in r.rows()}
+    assert by_name["trino_scan_bytes_total"][0] == "counter"
+    assert by_name["trino_scan_bytes_total"][1] > 0
+    assert by_name["trino_tasks_created_total"][1] > 0
+    # distributions flatten to summary rows
+    assert "trino_query_wall_seconds_p50" in by_name
+    assert "trino_query_wall_seconds_count" in by_name
+
+
+def test_system_tables_standalone_runner():
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.001))
+    runner.execute("select count(*) from nation")
+    rows = runner.execute(
+        "select query_id, state, input_rows from system.runtime.queries"
+    ).rows()
+    fin = [r for r in rows if r[1] == "FINISHED"]
+    assert fin and fin[-1][2] == 25  # nation scan counted as input
+
+
+# -------------------------------------------------- event enrichment
+
+
+def test_query_completed_event_enriched():
+    from trino_tpu.spi.eventlistener import EventListener
+
+    captured = []
+
+    class Capture(EventListener):
+        def query_completed(self, event):
+            captured.append(event)
+
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.001))
+    runner.event_listeners.add(Capture())
+    runner.execute("select count(*) from nation")
+    ev = captured[-1]
+    assert ev.state == "FINISHED"
+    assert ev.wall_ms > 0
+    assert ev.cpu_ms >= 0
+    assert ev.input_rows == 25
+    assert ev.input_bytes > 0
+    assert ev.retry_count == 0
+    assert ev.peak_memory_bytes >= 0
+
+
+def test_query_wall_distribution_records():
+    before = tm.QUERY_WALL_SECONDS.snapshot()["count"]
+    runner = StandaloneQueryRunner(default_catalog(scale_factor=0.001))
+    runner.execute("select 1")
+    runner.execute("select 2")
+    after = tm.QUERY_WALL_SECONDS.snapshot()["count"]
+    assert after >= before + 2

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Grep-based lint: every registered metric name is Prometheus-legal.
+
+The telemetry registry (trino_tpu/telemetry/metrics.py) validates names at
+registration time, but a misnamed metric in a lazily-imported module only
+blows up when that code path first runs — long after CI went green.  This
+lint finds every ``REGISTRY.counter("...")`` / ``.gauge("...")`` /
+``.distribution("...")`` registration site statically and enforces the
+naming scheme up front:
+
+- names match the Prometheus data model (``[a-zA-Z_:][a-zA-Z0-9_:]*``)
+- every name carries the mandatory ``trino_`` prefix (one flat namespace,
+  greppable across coordinator and worker scrapes)
+- counters end in ``_total`` (Prometheus counter convention; the registry
+  appends no suffix itself)
+- no metric name literal is registered at two distinct sites (two sites
+  silently sharing one cell is almost always a copy-paste bug; share the
+  module-level handle instead)
+
+A justified exception carries a ``# metric-ok`` pragma.  Like
+tools/lint_host_sync.py this is deliberately dumb — regex over lines, no
+AST — so it runs in milliseconds and is obvious to extend.
+
+Run directly (``python tools/lint_metric_names.py``; exit 1 on findings) or
+via the tier-1 test tests/test_metric_lint.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# one registration site: .counter("name" / .gauge("name" / .distribution("name
+REGISTRATION = re.compile(
+    r"\.(?P<kind>counter|gauge|distribution)\(\s*[\"'](?P<name>[^\"']*)[\"']")
+LEGAL = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+PREFIX = "trino_"
+SCAN_DIR = "trino_tpu"
+PRAGMA = "metric-ok"
+
+
+def lint_file(path: str) -> list[tuple[str, int, str, str]]:
+    """-> [(path, lineno, metric_name, problem)] for one file."""
+    findings = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            if PRAGMA in line:
+                continue
+            for m in REGISTRATION.finditer(line):
+                kind, name = m.group("kind"), m.group("name")
+                if not LEGAL.match(name):
+                    findings.append((path, lineno, name,
+                                     "illegal Prometheus metric name"))
+                elif not name.startswith(PREFIX):
+                    findings.append((path, lineno, name,
+                                     f"missing mandatory {PREFIX!r} prefix"))
+                elif kind == "counter" and not name.endswith("_total"):
+                    findings.append((path, lineno, name,
+                                     "counter name must end in '_total'"))
+    return findings
+
+
+def registrations(root: str) -> dict[str, list[tuple[str, int]]]:
+    """metric name -> [(path, lineno)] across the tree (duplicate check)."""
+    sites: dict[str, list[tuple[str, int]]] = {}
+    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if PRAGMA in line:
+                        continue
+                    for m in REGISTRATION.finditer(line):
+                        sites.setdefault(m.group("name"), []).append(
+                            (path, lineno))
+    return sites
+
+
+def run(root: str) -> list[tuple[str, int, str, str]]:
+    findings = []
+    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fn)))
+    for name, sites in sorted(registrations(root).items()):
+        if len(sites) > 1:
+            for path, lineno in sites[1:]:
+                findings.append((path, lineno, name,
+                                 f"duplicate registration (first at "
+                                 f"{sites[0][0]}:{sites[0][1]})"))
+    return findings
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = run(root)
+    for path, lineno, name, problem in findings:
+        rel = os.path.relpath(path, root)
+        print(f"{rel}:{lineno}: {name!r}: {problem}")
+    if findings:
+        print(f"\n{len(findings)} metric naming violation(s); "
+              f"annotate justified exceptions with  # {PRAGMA}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

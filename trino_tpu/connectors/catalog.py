@@ -53,14 +53,32 @@ class Catalog:
         return sorted(self._connectors)
 
     def resolve_table(self, name: str, default: str) -> tuple[str, str, TableSchema]:
-        """'table' or 'catalog.table' -> (catalog, table, schema)."""
+        """'table', 'catalog.table' or 'catalog.schema.table' ->
+        (catalog, table, schema).  Connectors with schema-qualified table
+        names (connectors/system.py: 'runtime.queries') resolve the
+        'schema.table' form first; the historical flat-namespace fallback
+        ('catalog.x.t' -> table 't') is preserved for everything else."""
         parts = name.split(".")
         if len(parts) == 1:
             cat, table = default, parts[0]
         elif len(parts) == 2:
             cat, table = parts
-        else:  # catalog.schema.table — schema namespaces are a later round
-            cat, table = parts[0], parts[-1]
+            if cat not in self._connectors and default in self._connectors:
+                # 'runtime.queries' under default_catalog='system': treat
+                # the whole name as a schema-qualified table of the default
+                try:
+                    schema = self._connectors[default].get_table_schema(name)
+                    return default, name, schema
+                except KeyError:
+                    pass
+        else:
+            cat = parts[0]
+            qualified = ".".join(parts[1:])
+            try:
+                schema = self.connector(cat).get_table_schema(qualified)
+                return cat, qualified, schema
+            except KeyError:
+                table = parts[-1]
         schema = self.connector(cat).get_table_schema(table)
         return cat, table, schema
 
@@ -73,6 +91,7 @@ def default_catalog(scale_factor: float = 0.01,
     temp directory per catalog, created lazily on first use."""
     from .file import FileConnector
     from .memory import BlackholeConnector, MemoryConnector
+    from .system import SystemConnector
     from .tpch import TpchConnector
 
     cat = Catalog()
@@ -80,4 +99,5 @@ def default_catalog(scale_factor: float = 0.01,
     cat.register("memory", MemoryConnector())
     cat.register("blackhole", BlackholeConnector())
     cat.register("file", FileConnector(file_root))
+    cat.register("system", SystemConnector())
     return cat

@@ -1,0 +1,156 @@
+"""System connector: the engine's own state as SQL tables.
+
+The ``system.runtime`` role (reference: connector/system/
+SystemConnector.java + RuntimeQueriesSystemTable / RuntimeTasksSystemTable,
+and the JMX connector for counters): the engine dogfoods its own scan path
+— rows come from the process-wide telemetry registries
+(telemetry/runtime.py) and the metrics snapshot (telemetry/metrics.py),
+served through the ordinary Connector SPI so every planner/executor layer
+treats them like any other table.
+
+Tables are schema-qualified (``runtime.queries`` etc.);
+``Catalog.resolve_table`` resolves ``system.runtime.queries`` by trying the
+schema-qualified name against this connector first.
+
+Cookbook:
+    SELECT query_id, state FROM system.runtime.queries
+    SELECT worker, count(*) FROM system.runtime.tasks GROUP BY worker
+    SELECT * FROM system.metrics.counters WHERE name LIKE 'trino_scan%'
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional, Sequence
+
+from ..spi.batch import Column, ColumnBatch
+from ..spi.connector import (
+    ColumnSchema,
+    Connector,
+    ConnectorPageSource,
+    Split,
+    TableSchema,
+)
+from ..spi.types import BIGINT, DOUBLE, VARCHAR
+
+__all__ = ["SystemConnector"]
+
+
+def _schema(name: str, cols: list[tuple]) -> TableSchema:
+    return TableSchema(name, tuple(ColumnSchema(n, t) for n, t in cols))
+
+
+_TABLES = {
+    "runtime.queries": _schema("runtime.queries", [
+        ("query_id", VARCHAR), ("state", VARCHAR), ("user", VARCHAR),
+        ("sql", VARCHAR), ("wall_ms", DOUBLE), ("cpu_ms", DOUBLE),
+        ("output_rows", BIGINT), ("input_rows", BIGINT),
+        ("input_bytes", BIGINT), ("retry_count", BIGINT),
+        ("peak_memory_bytes", BIGINT), ("error", VARCHAR),
+    ]),
+    "runtime.tasks": _schema("runtime.tasks", [
+        ("query_id", VARCHAR), ("task_id", VARCHAR), ("fragment", BIGINT),
+        ("task_index", BIGINT), ("worker", VARCHAR), ("state", VARCHAR),
+        ("wall_ms", DOUBLE), ("error", VARCHAR),
+    ]),
+    "metrics.counters": _schema("metrics.counters", [
+        ("name", VARCHAR), ("kind", VARCHAR), ("value", DOUBLE),
+    ]),
+}
+
+
+class _OneBatchSource(ConnectorPageSource):
+    def __init__(self, batch: ColumnBatch):
+        self._batch = batch
+        self._done = False
+
+    def get_next_batch(self) -> Optional[ColumnBatch]:
+        if self._done:
+            return None
+        self._done = True
+        return self._batch
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class SystemConnector(Connector):
+    name = "system"
+
+    def __init__(self):
+        self._runner = None  # weakref to an attached runner (optional)
+
+    def attach(self, runner) -> None:
+        """Bind a runner so dispatcher-tracked state (execution/control.py
+        DispatchManager) augments the process registries."""
+        self._runner = weakref.ref(runner)
+
+    # --- metadata ---------------------------------------------------------
+    def list_tables(self) -> list[str]:
+        return sorted(_TABLES)
+
+    def get_table_schema(self, table: str) -> TableSchema:
+        if table not in _TABLES:
+            raise KeyError(f"no such system table: {table!r}")
+        return _TABLES[table]
+
+    # --- reads ------------------------------------------------------------
+    def get_splits(self, table: str, splits_per_node: int,
+                   node_count: int) -> list[Split]:
+        self.get_table_schema(table)  # KeyError on unknown tables
+        return [Split("system", table, None)]
+
+    def create_page_source(self, split: Split, columns: Sequence[str],
+                           constraint=None) -> ConnectorPageSource:
+        rows = self._rows(split.table)
+        schema = _TABLES[split.table]
+        by_name = {c.name: (i, c.type) for i, c in enumerate(schema.columns)}
+        cols = []
+        for name in columns:
+            idx, typ = by_name[name]
+            cols.append(Column.from_values(typ, [r[idx] for r in rows]))
+        return _OneBatchSource(ColumnBatch(list(columns), cols))
+
+    def _rows(self, table: str) -> list[tuple]:
+        from ..telemetry import metrics, runtime
+
+        if table == "runtime.queries":
+            out = [
+                (q.query_id, q.state, q.user, q.sql, q.wall_ms, q.cpu_ms,
+                 q.output_rows, q.input_rows, q.input_bytes, q.retry_count,
+                 q.peak_memory_bytes, q.error)
+                for q in runtime.queries()
+            ]
+            # dispatcher-tracked queries (control.py FSM) that predate or
+            # bypass run_with_query_events show up with their FSM state
+            runner = self._runner() if self._runner is not None else None
+            dispatcher = getattr(runner, "dispatcher", None)
+            if dispatcher is not None:
+                seen = {r[0] for r in out}
+                for info in dispatcher.queries():
+                    if info.query_id not in seen:
+                        out.append((info.query_id, info.state, "", info.sql,
+                                    0.0, 0.0, -1, 0, 0, 0, 0, None))
+            return out
+        if table == "runtime.tasks":
+            return [
+                (t.query_id, t.task_id, t.fragment, t.task_index, t.worker,
+                 t.state, t.wall_ms, t.error)
+                for t in runtime.tasks()
+            ]
+        if table == "metrics.counters":
+            out = []
+            for name, snap in metrics.REGISTRY.snapshot().items():
+                kind = snap["kind"]
+                if kind == "distribution":
+                    # flatten: scalar summary rows per distribution
+                    for suffix, v in (("count", snap["count"]),
+                                      ("sum", snap["sum"]),
+                                      ("p50", snap["p50"]),
+                                      ("p90", snap["p90"]),
+                                      ("p99", snap["p99"])):
+                        out.append((f"{name}_{suffix}", kind, float(v)))
+                else:
+                    out.append((name, kind, float(snap["value"])))
+            return out
+        raise KeyError(f"no such system table: {table!r}")

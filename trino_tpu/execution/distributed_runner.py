@@ -95,6 +95,11 @@ class DistributedQueryRunner:
         # cumulative count of fused-stage overflow fallbacks (whole-stage
         # compilation re-running a subplan on the legacy per-operator path)
         self.fused_fallbacks = 0
+        # system catalog (connectors/system.py): bind this runner so
+        # dispatcher-tracked query state shows up in system.runtime.queries
+        sysconn = self.catalog._connectors.get("system")
+        if sysconn is not None and hasattr(sysconn, "attach"):
+            sysconn.attach(self)
 
     # ------------------------------------------------------------------ plan
     def create_plan(self, sql: str) -> PlanNode:
@@ -241,8 +246,14 @@ class DistributedQueryRunner:
         finally:
             delta = ResilienceStats.delta(self.resilience, before)
             if delta.any:
+                from ..telemetry import metrics as tm
+                from ..telemetry import runtime as rt
                 from .tracing import annotate_resilience_span
 
+                tm.observe_resilience(delta)
+                rec = rt.current_record()
+                if rec is not None:
+                    rt.add_retries(rec, delta.query_retries)
                 span = self.tracer.current()
                 if span is not None:
                     annotate_resilience_span(span, delta)
@@ -324,6 +335,12 @@ class DistributedQueryRunner:
                 fragments, stages, errors, stats_sink, edges,
                 attempt)
         else:
+            from ..telemetry import runtime as _rt
+
+            # task spans nest under the coordinator thread's open query
+            # span via explicit cross-thread parenting (tracing.py parent=)
+            parent_span = self.tracer.current()
+            qrec = _rt.current_record()
             threads: list[threading.Thread] = []
             for f in fragments:
                 stage = stages[f.id]
@@ -331,7 +348,7 @@ class DistributedQueryRunner:
                     th = threading.Thread(
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
-                              edges, attempt),
+                              edges, attempt, parent_span, qrec),
                         name=f"task-{f.id}.{t}",
                         daemon=True,
                     )
@@ -376,6 +393,9 @@ class DistributedQueryRunner:
             roll = FusedStageStats()
             for ex in fused_edges.values():
                 roll.merge(ex.stats)
+            from ..telemetry.metrics import observe_fused
+
+            observe_fused(roll)
             span = self.tracer.current()
             if span is not None:
                 annotate_fused_span(span, roll)
@@ -648,21 +668,57 @@ class DistributedQueryRunner:
                   stages: dict[int, "_Stage"], errors: list,
                   stats_sink: Optional[list] = None,
                   collective: Optional[dict] = None,
-                  attempt: int = 0) -> None:
-        try:
-            pipelines, stats = self._build_task(
-                stage, task_index, stages, stats_sink, collective or {},
-                attempt)
-            run_pipelines(pipelines, stats)
-        except BaseException as e:  # noqa: BLE001 — surfaced to coordinator
-            errors.append(e)
-            # unblock every sibling immediately: producers stuck in enqueue
-            # backpressure, consumers polling this (now dead) task, and
-            # partners parked at a collective all_to_all barrier would
-            # otherwise wait out the full join timeout before the real error
-            # surfaces
-            for s in stages.values():
-                for b in s.buffers:
-                    b.abort()
-            for ex in (collective or {}).values():
-                ex.abort()
+                  attempt: int = 0, parent_span=None,
+                  query_record=None) -> None:
+        import time as _time
+
+        from ..exec.driver import collect_scan_stats
+        from ..telemetry import metrics as tm
+        from ..telemetry import runtime as rt
+        from .tracing import annotate_scan_span
+
+        tm.TASKS_CREATED.inc()
+        trec = rt.task_started(
+            query_record.query_id if query_record is not None else "",
+            f"f{stage.fragment.id}.t{task_index}", stage.fragment.id,
+            task_index, "local")
+        t0 = _time.perf_counter()
+        pipelines = None
+        state = "FINISHED"
+        err = None
+        with self.tracer.span(
+                "trino.task", parent=parent_span,
+                **{"trino.task.id": trec.task_id,
+                   "trino.task.worker": "local"}) as sp:
+            try:
+                pipelines, stats = self._build_task(
+                    stage, task_index, stages, stats_sink, collective or {},
+                    attempt)
+                run_pipelines(pipelines, stats)
+            except BaseException as e:  # noqa: BLE001 — surfaced to
+                # coordinator
+                errors.append(e)
+                state = "FAILED"
+                err = f"{type(e).__name__}: {e}"
+                sp.set("error", type(e).__name__)
+                # unblock every sibling immediately: producers stuck in
+                # enqueue backpressure, consumers polling this (now dead)
+                # task, and partners parked at a collective all_to_all
+                # barrier would otherwise wait out the full join timeout
+                # before the real error surfaces
+                for s in stages.values():
+                    for b in s.buffers:
+                        b.abort()
+                for ex in (collective or {}).values():
+                    ex.abort()
+            ingest = collect_scan_stats(pipelines) if pipelines else None
+            if ingest is not None:
+                annotate_scan_span(sp, ingest)
+                tm.observe_scan(ingest)
+                if query_record is not None:
+                    rt.add_input(query_record, ingest.scan_rows,
+                                 ingest.scan_bytes)
+        tm.TASK_WALL_SECONDS.record(_time.perf_counter() - t0)
+        if state == "FAILED":
+            tm.TASKS_FAILED.inc()
+        rt.task_finished(trec, state, error=err)

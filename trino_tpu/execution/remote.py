@@ -50,8 +50,10 @@ __all__ = ["HttpExchangeClient", "HttpRemoteTask",
 
 
 def _http(method: str, url: str, data: Optional[bytes] = None,
-          timeout: float = 30.0):
+          timeout: float = 30.0, headers: Optional[dict] = None):
     req = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     # per-spawn internal shared secret (reference: server/
     # InternalCommunicationConfig.java:33 sharedSecret) — every node in the
     # cluster process tree carries it via env; the worker rejects mutating
@@ -77,7 +79,11 @@ class HttpExchangeClient:
     task descriptors."""
 
     def __init__(self, task_uris: list[str], partition: int,
-                 backoff: Optional[dict] = None):
+                 backoff: Optional[dict] = None,
+                 traceparent: Optional[str] = None):
+        # trace context rides every results fetch (the reference propagates
+        # OTel context on all task calls); servers are free to ignore it
+        self._traceparent = traceparent
         cfg = backoff or {}
         # [uri, token, done, Backoff]
         self._sources = [[u, 0, False, Backoff(
@@ -104,8 +110,12 @@ class HttpExchangeClient:
         # small grace on top for page serialization + transfer
         maxwait = min(max(timeout, 0.0), 5.0)
         url = f"{uri}/results/{self.partition}/{token}?maxwait={maxwait:g}"
+        t0 = time.perf_counter()
+        hdrs = ({"traceparent": self._traceparent}
+                if self._traceparent else None)
         try:
-            with _http("GET", url, timeout=maxwait + 5.0) as resp:
+            with _http("GET", url, timeout=maxwait + 5.0,
+                       headers=hdrs) as resp:
                 body = resp.read()
                 next_token = int(resp.headers.get("X-Next-Token", token))
                 done = bool(int(resp.headers.get("X-Done", 0)))
@@ -155,6 +165,9 @@ class HttpExchangeClient:
             count += 1
         s[1] = next_token
         s[2] = done
+        from ..telemetry.metrics import observe_exchange
+
+        observe_exchange(len(body), count, time.perf_counter() - t0)
         return count
 
     def poll(self, timeout: float = 0.05) -> Optional[ColumnBatch]:
@@ -182,9 +195,11 @@ class HttpRemoteTask:
         self.task_id = task_id
         self.uri = f"{worker_url}/v1/task/{task_id}"
 
-    def create(self, descriptor: dict) -> None:
+    def create(self, descriptor: dict,
+               traceparent: Optional[str] = None) -> None:
+        headers = {"traceparent": traceparent} if traceparent else None
         with _http("POST", self.uri, encode_descriptor(descriptor),
-                   timeout=60.0) as resp:
+                   timeout=60.0, headers=headers) as resp:
             assert resp.status == 200
 
     def status(self) -> dict:
@@ -509,10 +524,49 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                         f"task f{fid}.t{t} FAILED: {st.get('error')}",
                         remote_host=wurl)
 
+    def _collect_task_spans(self, tasks: dict, parent_span) -> None:
+        """Re-attach every worker task's finished span subtree under the
+        coordinator's query span — one distributed trace tree per query.
+        Workers publish the span BEFORE the terminal state, but the client
+        drain can observe the last page slightly before the producer flips
+        state, hence the short bounded re-poll.  Scan totals travel as
+        ``trino.scan.*`` span attributes and fold into the coordinator's
+        query record (worker processes keep their own metric registries)."""
+        if parent_span is None:
+            return
+        from ..telemetry import runtime as rt
+        from .tracing import Span
+
+        rec = rt.current_record()
+        budget = time.monotonic() + 5.0
+        for remote_task in tasks.values():
+            d = None
+            while True:
+                st = remote_task.status()
+                d = st.get("span")
+                if d is not None or st.get("state") != "RUNNING" \
+                        or time.monotonic() > budget:
+                    break
+                time.sleep(0.05)
+            if not d:
+                continue
+            sub = Span.from_dict(d)
+            parent_span.children.append(sub)
+            if rec is not None:
+                rt.add_input(rec,
+                             int(sub.attributes.get("trino.scan.rows", 0)),
+                             int(sub.attributes.get("trino.scan.bytes", 0)))
+
     def _run_remote(self, subplan: SubPlan, attempt: int = 0,
                     blacklist: frozenset = frozenset()) -> QueryResult:
+        from .tracing import traceparent as _traceparent
+
         self._query_seq += 1
         qid = f"pq{self._query_seq}"
+        # the open trino.query span (run_with_query_events) becomes the
+        # remote parent of every worker task span for this attempt
+        parent_span = self.tracer.current()
+        tp = _traceparent(parent_span) if parent_span is not None else None
         fragments = subplan.all_fragments()
         task_counts, consumer_tasks = self.stage_task_counts(fragments)
         alive = self._placement_workers(blacklist)
@@ -551,6 +605,7 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                         "task_count": tc,
                         "num_partitions": consumer_tasks.get(f.id, 1),
                         "attempt": attempt,
+                        "query_id": qid,
                         "upstream": upstream,
                         "catalog": self.catalog_spec,
                         "splits_per_node": self.session.splits_per_node,
@@ -569,7 +624,7 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                     }
                     rt = tasks[(f.id, t)]
                     try:
-                        rt.create(desc)
+                        rt.create(desc, traceparent=tp)
                     except BaseException as e:  # noqa: BLE001
                         te = classify(e)
                         te.remote_host = te.remote_host or \
@@ -582,7 +637,8 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
             root_uris = [tasks[(root.id, t)].uri
                          for t in range(task_counts[root.id])]
             client = HttpExchangeClient(root_uris, 0,
-                                        backoff=self._exchange_backoff_cfg())
+                                        backoff=self._exchange_backoff_cfg(),
+                                        traceparent=tp)
             batches: list[ColumnBatch] = []
             deadline = time.monotonic() + 600
             last_status = 0.0
@@ -597,6 +653,7 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                     self._check_workers(by_worker)
                 if now > deadline:
                     raise TimeoutError("remote query stalled")
+            self._collect_task_spans(tasks, parent_span)
             return self._to_result(subplan, batches)
         except BaseException:
             for rt in tasks.values():

@@ -816,12 +816,27 @@ class FusedStageSinkOperator(Operator):
                tuple(None if r is None else len(r) for r in state_remaps))
         with _ACCUM_LOCK:
             if sig in _TRACE_SIGS:
+                fresh = False
                 self.stats.cache_hits += 1
             else:
+                fresh = True
                 _TRACE_SIGS.add(sig)
                 self.stats.compiles += 1
-        self._state = prog(self._state, cols, live,
-                           tuple(batch_remaps), tuple(state_remaps))
+        if fresh:
+            # a fresh (prog, shape-bucket) signature means this call traces
+            # + compiles; its wall time goes to the compile histogram
+            import time as _time
+
+            from ..telemetry import metrics as tm
+
+            t0 = _time.perf_counter()
+            self._state = prog(self._state, cols, live,
+                               tuple(batch_remaps), tuple(state_remaps))
+            tm.FUSED_COMPILES.inc()
+            tm.FUSED_COMPILE_SECONDS.record(_time.perf_counter() - t0)
+        else:
+            self._state = prog(self._state, cols, live,
+                               tuple(batch_remaps), tuple(state_remaps))
         self._prog = prog
         self.stats.jit_calls += 1
         self.stats.batches += 1

@@ -11,11 +11,44 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-__all__ = ["Span", "Tracer", "annotate_scan_span", "annotate_sync_span",
+__all__ = ["Span", "Tracer", "traceparent", "parse_traceparent",
+           "annotate_scan_span", "annotate_sync_span",
            "annotate_resilience_span", "annotate_fused_span"]
+
+
+def _new_trace_id() -> str:
+    return uuid.uuid4().hex  # 32 hex chars (the W3C trace-id width)
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:16]  # 16 hex chars (the W3C span-id width)
+
+
+def traceparent(span: "Span") -> str:
+    """W3C-traceparent-style header value for propagating ``span`` as the
+    remote parent across the HTTP plane (reference:
+    tracing/TracingMetadata.java:121 injecting context into task calls)."""
+    if not span.trace_id:
+        span.trace_id = _new_trace_id()
+    if not span.span_id:
+        span.span_id = _new_span_id()
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple[str, str]]:
+    """``"00-<trace>-<span>-01"`` -> (trace_id, parent_span_id), or None on
+    anything malformed (propagation is best-effort, never a failure)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
 
 
 def annotate_fused_span(span: "Span", fs) -> None:
@@ -92,6 +125,11 @@ class Span:
     start: float = 0.0
     end: Optional[float] = None
     children: list["Span"] = field(default_factory=list)
+    # distributed identity: trace_id is shared by the whole query tree,
+    # parent_id links a child to its parent across process boundaries
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: Optional[str] = None
 
     @property
     def duration_ms(self) -> float:
@@ -109,6 +147,31 @@ class Span:
         for c in self.children:
             lines.append(c.text(indent + 1))
         return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        """JSON-safe subtree for shipping finished spans across processes
+        (worker -> coordinator with task completion).  Durations travel as
+        milliseconds: perf_counter timestamps are not comparable across
+        processes, so absolute start/end stay process-local."""
+        return {
+            "name": self.name,
+            "attributes": dict(self.attributes),
+            "duration_ms": self.duration_ms,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        s = cls(d["name"], dict(d.get("attributes", {})),
+                start=0.0, end=d.get("duration_ms", 0.0) / 1e3,
+                trace_id=d.get("trace_id", ""),
+                span_id=d.get("span_id", ""),
+                parent_id=d.get("parent_id"))
+        s.children = [cls.from_dict(c) for c in d.get("children", [])]
+        return s
 
 
 class _SpanCtx:
@@ -135,8 +198,9 @@ class Tracer:
                  keep: int = 50):
         self._local = threading.local()
         self._exporter = exporter
-        self._keep = keep
-        self.finished: list[Span] = []
+        # deque(maxlen=keep): O(1) ring eviction (list.pop(0) was O(n) per
+        # finished root, under the lock)
+        self.finished: deque = deque(maxlen=keep)
         self._lock = threading.Lock()
 
     def _stack(self) -> list:
@@ -144,11 +208,35 @@ class Tracer:
             self._local.stack = []
         return self._local.stack
 
-    def span(self, name: str, **attributes) -> _SpanCtx:
-        s = Span(name, dict(attributes), time.perf_counter())
+    def span(self, name: str, parent: Optional[Span] = None,
+             remote: Optional[tuple[str, str]] = None,
+             **attributes) -> _SpanCtx:
+        """Open a span.  Default parenting is the current thread's open
+        span.  ``parent=`` attaches to an explicit span on ANOTHER thread
+        (task threads nesting under the query span).  ``remote=`` is a
+        (trace_id, parent_span_id) pair from ``parse_traceparent``: the
+        span becomes a local root carrying the remote identity, so the
+        coordinator can re-attach the shipped subtree."""
+        s = Span(name, dict(attributes), time.perf_counter(),
+                 span_id=_new_span_id())
         stack = self._stack()
-        if stack:
+        if parent is not None:
+            if not parent.trace_id:
+                parent.trace_id = _new_trace_id()
+            if not parent.span_id:
+                parent.span_id = _new_span_id()
+            s.trace_id = parent.trace_id
+            s.parent_id = parent.span_id
+            parent.children.append(s)  # list.append: thread-safe
+        elif remote is not None:
+            s.trace_id, s.parent_id = remote
+            s._remote_root = True
+        elif stack:
+            s.trace_id = stack[-1].trace_id
+            s.parent_id = stack[-1].span_id
             stack[-1].children.append(s)
+        else:
+            s.trace_id = _new_trace_id()
         stack.append(s)
         return _SpanCtx(self, s)
 
@@ -160,10 +248,15 @@ class Tracer:
         stack = self._stack()
         if stack and stack[-1] is span:
             stack.pop()
-        if not stack:  # root finished
-            with self._lock:
-                self.finished.append(span)
-                while len(self.finished) > self._keep:
-                    self.finished.pop(0)
-            if self._exporter is not None:
-                self._exporter(span)
+        if stack:
+            return
+        # the thread's outermost span closed.  A span attached to an
+        # explicit cross-thread parent is NOT a root (it already lives in
+        # its parent's subtree); remote-parented spans ARE local roots.
+        if span.parent_id is not None and \
+                not getattr(span, "_remote_root", False):
+            return
+        with self._lock:
+            self.finished.append(span)
+        if self._exporter is not None:
+            self._exporter(span)

@@ -66,21 +66,34 @@ class _Task:
         self.error_type: Optional[str] = None
         self.error_code: Optional[str] = None
         self.buffer = None  # OutputBuffer, set when planning completes
+        # finished task span subtree (tracing.Span.to_dict) — published
+        # BEFORE the terminal state so a status read that observes
+        # FINISHED/FAILED always sees the span too
+        self.span: Optional[dict] = None
         self.ready = threading.Event()
         self.thread: Optional[threading.Thread] = None
 
-    def status_json(self) -> dict:
-        return {"state": self.state, "error": self.error,
-                "error_type": self.error_type, "error_code": self.error_code}
+    def status_json(self, include_span: bool = False) -> dict:
+        out = {"state": self.state, "error": self.error,
+               "error_type": self.error_type, "error_code": self.error_code}
+        if include_span and self.span is not None:
+            out["span"] = self.span
+        return out
 
 
 class TaskServer:
     def __init__(self, port: int = 0):
         import os
 
+        from .tracing import Tracer
+
         self.tasks: dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._draining = False
+        # worker-local span collector: task spans are remote-parented from
+        # the coordinator's traceparent header and shipped back (serialized)
+        # with task completion
+        self.tracer = Tracer(keep=200)
         # per-spawn shared secret (reference: InternalCommunicationConfig
         # sharedSecret): descriptors are pickles, so only the process tree
         # holding the secret may reach any endpoint that decodes or mutates
@@ -161,10 +174,18 @@ class TaskServer:
                 "state": "SHUTTING_DOWN" if self._draining else "ACTIVE",
                 "tasks": len(self.tasks)}).encode())
             return
+        if parts == ["v1", "metrics"]:
+            # Prometheus text exposition of the worker-process registry
+            from ..telemetry.metrics import REGISTRY
+
+            h._send(200, REGISTRY.render_prometheus().encode(),
+                    "text/plain; version=0.0.4")
+            return
         if parts == ["v1", "status"]:
             # the heartbeat target: node state + EVERY task's state in one
             # payload, so the coordinator sweeps one poll per worker
-            # (failure_detector.py caches this)
+            # (failure_detector.py caches this).  Spans stay out of the
+            # sweep — they're fetched per task on completion.
             h._send(200, json.dumps({
                 "state": "SHUTTING_DOWN" if self._draining else "ACTIVE",
                 "tasks": {tid: t.status_json()
@@ -177,7 +198,8 @@ class TaskServer:
             if t is None:
                 h._send(404, b'{"error": "no such task"}')
                 return
-            h._send(200, json.dumps(t.status_json()).encode())
+            h._send(200, json.dumps(t.status_json(
+                include_span=True)).encode())
             return
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results":
@@ -249,7 +271,8 @@ class TaskServer:
                 t = _Task(task_id)
                 self.tasks[task_id] = t
             t.thread = threading.Thread(
-                target=self._run_task, args=(t, desc), daemon=True,
+                target=self._run_task,
+                args=(t, desc, h.headers.get("traceparent")), daemon=True,
                 name=f"task-{task_id}")
             t.thread.start()
             h._send(200, b'{"state": "RUNNING"}')
@@ -293,8 +316,33 @@ class TaskServer:
         self.httpd.shutdown()
 
     # ------------------------------------------------------------ execution
-    def _run_task(self, t: _Task, desc: dict) -> None:
+    def _run_task(self, t: _Task, desc: dict,
+                  traceparent_header: Optional[str] = None) -> None:
+        import time as _time
+
+        from ..telemetry import metrics as tm
+        from ..telemetry import runtime as rt
+        from .tracing import annotate_scan_span, parse_traceparent
+
+        tm.TASKS_CREATED.inc()
+        worker_addr = f"127.0.0.1:{self.port}"
+        trec = rt.task_started(
+            str(desc.get("query_id", "")), t.task_id,
+            getattr(desc.get("fragment"), "id", -1),
+            desc.get("task_index", -1), worker_addr)
+        t0 = _time.perf_counter()
+        # remote-parented span: the coordinator's traceparent header makes
+        # this a local root carrying the query's trace identity; the ctx is
+        # entered/exited explicitly so the span can close (and publish to
+        # t.span) BEFORE the terminal state becomes visible
+        ctx = self.tracer.span(
+            "trino.task", remote=parse_traceparent(traceparent_header),
+            **{"trino.task.id": t.task_id,
+               "trino.task.worker": worker_addr})
+        sp = ctx.__enter__()
         writer = None
+        local = None
+        state = "FINISHED"
         try:
             from ..exec.driver import run_pipelines
             from ..exec.local_planner import LocalPlanner
@@ -355,17 +403,24 @@ class TaskServer:
                         clients[src_id] = DurableSpoolClient(
                             info["dirs"], task_index, on_read)
             backoff_cfg = desc.get("exchange_backoff")
+            # this task's exchange fetches carry ITS span as the trace
+            # context (trace_id stays the query's)
+            from .tracing import traceparent as _tp
+
+            task_tp = _tp(sp)
             for src_id, info in desc.get("upstream", {}).items():
                 uris = info["uris"]
                 if info.get("merge"):
                     clients[src_id] = [
                         HttpExchangeClient([u], task_index,
-                                           backoff=backoff_cfg)
+                                           backoff=backoff_cfg,
+                                           traceparent=task_tp)
                         for u in uris
                     ]
                 else:
                     clients[src_id] = HttpExchangeClient(
-                        uris, task_index, backoff=backoff_cfg)
+                        uris, task_index, backoff=backoff_cfg,
+                        traceparent=task_tp)
             planner = LocalPlanner(
                 catalog,
                 splits_per_node=desc.get("splits_per_node", 4),
@@ -378,9 +433,10 @@ class TaskServer:
             )
             local = planner.plan(fragment.root)
             if "spool" in desc:  # FTE: durable on-disk attempt spool
-                sp = desc["spool"]
+                spool = desc["spool"]
                 writer = DurableSpoolWriter(
-                    sp["task_dir"], sp["attempt"], sp["num_partitions"])
+                    spool["task_dir"], spool["attempt"],
+                    spool["num_partitions"])
                 out = writer
             else:
                 out = OutputBuffer(desc["num_partitions"])
@@ -394,7 +450,6 @@ class TaskServer:
                 t.buffer = out
             t.ready.set()
             run_pipelines(local.pipelines)
-            t.state = "FINISHED"
         except BaseException as e:  # noqa: BLE001 — reported to coordinator
             from ..spi.errors import classify
 
@@ -402,12 +457,33 @@ class TaskServer:
             t.error = f"{type(e).__name__}: {e}"
             t.error_type = te.error_type
             t.error_code = te.code.name
-            t.state = "FAILED"
+            state = "FAILED"
+            sp.set("error", type(e).__name__)
             if t.buffer is not None:
                 t.buffer.abort()
             if writer is not None:
                 writer.abort()
             t.ready.set()
+        try:
+            if local is not None:
+                from ..exec.driver import collect_scan_stats
+
+                ingest = collect_scan_stats(local.pipelines)
+                annotate_scan_span(sp, ingest)
+                tm.observe_scan(ingest)
+        except Exception:  # noqa: BLE001 — stats never fail a task
+            pass
+        try:
+            ctx.__exit__(None, None, None)
+            t.span = sp.to_dict()  # span visible before terminal state read
+            tm.TASK_WALL_SECONDS.record(_time.perf_counter() - t0)
+            if state == "FAILED":
+                tm.TASKS_FAILED.inc()
+            rt.task_finished(trec, state, error=t.error)
+        finally:
+            # the terminal state MUST always land: a coordinator polling
+            # status would otherwise wait on a RUNNING task forever
+            t.state = state
 
     def serve_forever(self) -> None:
         self.httpd.serve_forever()

@@ -272,27 +272,49 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
 
 def run_with_query_events(qid: str, sql: str, user: str, listeners, tracer,
                           thunk):
-    """Shared query lifecycle wrapper: created/completed events + the root
-    tracing span around ``thunk`` (both runners use this; reference:
-    QueryMonitor emitting eventlistener events around the dispatch)."""
+    """Shared query lifecycle wrapper: created/completed events, the root
+    tracing span, the process query registry entry
+    (telemetry/runtime.py -> system.runtime.queries) and the query-level
+    metrics (telemetry/metrics.py) around ``thunk`` (both runners use this;
+    reference: QueryMonitor emitting eventlistener events around the
+    dispatch).  ``cpu_ms`` is process CPU over the query window —
+    concurrent queries overlap in it, like the reference's per-node
+    cumulative totals."""
     import time as _time
 
     from .spi.eventlistener import QueryCompletedEvent, QueryCreatedEvent
+    from .telemetry import metrics as tm
+    from .telemetry import runtime as rt
 
     listeners.query_created(QueryCreatedEvent(qid, sql, user))
+    rec = rt.query_started(qid, sql, user)
+    tm.QUERIES_STARTED.inc()
     t0 = _time.perf_counter()
+    cpu0 = _time.process_time()
+
+    def _finish(state: str, rows: int, error):
+        wall = (_time.perf_counter() - t0) * 1e3
+        cpu = (_time.process_time() - cpu0) * 1e3
+        tm.QUERY_WALL_SECONDS.record(wall / 1e3)
+        (tm.QUERIES_FINISHED if state == "FINISHED"
+         else tm.QUERIES_FAILED).inc()
+        peak = tm.update_device_memory_watermark() or 0
+        rt.query_finished(rec, state, wall, cpu, rows, error,
+                          peak_memory_bytes=peak)
+        listeners.query_completed(QueryCompletedEvent(
+            qid, sql, state, user, wall, rows, error,
+            cpu_ms=cpu, peak_memory_bytes=peak,
+            input_rows=rec.input_rows, input_bytes=rec.input_bytes,
+            retry_count=rec.retry_count))
+
     try:
         with tracer.span("trino.query", query_id=qid):
             result = thunk()
     except BaseException as e:
-        listeners.query_completed(QueryCompletedEvent(
-            qid, sql, "FAILED", user,
-            (_time.perf_counter() - t0) * 1e3, -1, str(e)))
+        _finish("FAILED", -1, str(e))
         raise
     rows = result.batch.live_count if result.batch.columns else 0
-    listeners.query_completed(QueryCompletedEvent(
-        qid, sql, "FINISHED", user,
-        (_time.perf_counter() - t0) * 1e3, rows))
+    _finish("FINISHED", rows, None)
     return result
 
 
@@ -440,6 +462,9 @@ class StandaloneQueryRunner:
         self.event_listeners = EventListenerManager()
         self.access_control = AccessControlManager()
         self._qids = itertools.count(1)
+        sysconn = self.catalog._connectors.get("system")
+        if sysconn is not None and hasattr(sysconn, "attach"):
+            sysconn.attach(self)
 
     def create_plan(self, sql: str) -> PlanNode:
         return self._plan_stmt(parse_statement(sql))
@@ -510,8 +535,18 @@ class StandaloneQueryRunner:
         sync_before = syncguard.snapshot()
         with self.tracer.span("trino.execution") as sp:
             run_pipelines(local.pipelines, stats)
-            annotate_scan_span(sp, collect_scan_stats(local.pipelines))
-            annotate_sync_span(sp, syncguard.take_delta(sync_before))
+            ingest = collect_scan_stats(local.pipelines)
+            sync_delta = syncguard.take_delta(sync_before)
+            annotate_scan_span(sp, ingest)
+            annotate_sync_span(sp, sync_delta)
+        from .telemetry import metrics as tm
+        from .telemetry import runtime as rt
+
+        tm.observe_scan(ingest)
+        tm.observe_sync(sync_delta)
+        if ingest is not None:
+            rt.add_input(rt.current_record(), ingest.scan_rows,
+                         ingest.scan_bytes)
         batches = local.collector.batches
         if batches:
             batch = ColumnBatch.concat(batches)

@@ -64,6 +64,9 @@ class QueryDispatcher:
     MAX_RETAINED = 256
 
     def submit(self, sql: str) -> _Query:
+        from ..telemetry.metrics import DISPATCHER_QUERIES
+
+        DISPATCHER_QUERIES.inc()
         q = _Query(uuid.uuid4().hex[:16], sql)
         with self._lock:
             self.queries[q.id] = q
@@ -159,6 +162,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         parts = self.path.strip("/").split("/")
+        if parts == ["v1", "metrics"]:
+            # Prometheus text exposition of the coordinator-process
+            # registry (_send is JSON-only, so write the text inline)
+            from ..telemetry.metrics import REGISTRY
+
+            body = REGISTRY.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         # /v1/statement/{id}/{token}
         if len(parts) != 4 or parts[:2] != ["v1", "statement"]:
             self._send(404, {"error": {"message": "not found"}})

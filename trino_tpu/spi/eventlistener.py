@@ -33,6 +33,15 @@ class QueryCompletedEvent:
     wall_ms: float = 0.0
     output_rows: int = -1
     error: Optional[str] = None
+    # final QueryStats roll-up (the reference ships cpu/wall/peak-memory/
+    # input counts in its QueryCompletedEvent statistics block): process CPU
+    # over the query window, device allocator peak, scanned input, and the
+    # retry_policy=QUERY attempt count
+    cpu_ms: float = 0.0
+    peak_memory_bytes: int = 0
+    input_rows: int = 0
+    input_bytes: int = 0
+    retry_count: int = 0
     end_time: float = field(default_factory=time.time)
 
 

@@ -1,0 +1,7 @@
+"""Telemetry plane: process-wide metrics registry + runtime registries.
+
+- :mod:`metrics` — Counter/Gauge/Distribution with Prometheus text
+  exposition (the airlift CounterStat/TimeStat/DistributionStat role).
+- :mod:`runtime` — bounded query/task registries feeding the
+  ``system.runtime`` connector (connectors/system.py).
+"""

@@ -1,0 +1,537 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+The airlift stats plane in miniature (reference: airlift ``CounterStat`` /
+``TimeStat`` / ``DistributionStat`` exported per process and scraped over
+HTTP): a singleton :data:`REGISTRY` of named metrics, rendered as Prometheus
+text exposition format by ``GET /v1/metrics`` on both the coordinator
+(server/protocol.py) and every worker (execution/worker.py).
+
+Hot-path contract: *recording never takes a device sync or a contended
+lock*.  Counters and distributions write to per-thread cells — the only
+lock is taken once per (thread, metric) pair at cell creation, and again
+only at snapshot/render time to sum the cells.  Gauges are a single
+attribute store.  Nothing here touches jax arrays, so the SyncGuard
+accounting (exec/syncguard.py) is structurally unaffected.
+
+Distributions use fixed log-spaced buckets (``lo * growth**i``), merge by
+bucket-count addition (cross-thread and, via :meth:`Distribution.merge`,
+cross-process), and estimate p50/p90/p99 by linear interpolation inside
+the winning bucket — the fixed-bucket ``DistributionStat`` role.
+
+Metric naming scheme (enforced here at registration AND by
+tools/lint_metric_names.py at the source level): Prometheus-legal
+``[a-zA-Z_:][a-zA-Z0-9_:]*``, mandatory ``trino_`` prefix, counters end in
+``_total``, distributions carry a unit suffix (``_seconds``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+import weakref
+from typing import Optional
+
+__all__ = [
+    "Counter", "Gauge", "Distribution", "MetricsRegistry", "REGISTRY",
+    "observe_scan", "observe_sync", "observe_resilience", "observe_fused",
+    "observe_exchange", "update_device_memory_watermark",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+METRIC_PREFIX = "trino_"
+
+
+def _validate_name(name: str, kind: str) -> None:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"metric name not Prometheus-legal: {name!r}")
+    if not name.startswith(METRIC_PREFIX):
+        raise ValueError(
+            f"metric name missing the {METRIC_PREFIX!r} prefix: {name!r}")
+    if kind == "counter" and not name.endswith("_total"):
+        raise ValueError(f"counter name must end in '_total': {name!r}")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.10g}"
+
+
+class _Cell:
+    """One thread's private accumulator; folded into a retired total once
+    the owning thread dies (task threads are per-query, so cells must not
+    accumulate over the process lifetime)."""
+
+    __slots__ = ("value", "thread_ref")
+
+    def __init__(self):
+        self.value = 0
+        self.thread_ref = weakref.ref(threading.current_thread())
+
+
+class Counter:
+    """Monotonic counter; ``inc`` is a thread-local add (no contended lock,
+    no device sync)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._local = threading.local()
+        self._cells: list[_Cell] = []
+        self._retired = 0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1) -> None:
+        cell = getattr(self._local, "cell", None)
+        if cell is None:
+            cell = _Cell()
+            with self._lock:
+                self._cells.append(cell)
+            self._local.cell = cell
+        cell.value += amount
+
+    def value(self):
+        with self._lock:
+            live = []
+            for c in self._cells:
+                t = c.thread_ref()
+                if t is None or not t.is_alive():
+                    self._retired += c.value  # dead thread: fold and drop
+                else:
+                    live.append(c)
+            self._cells = live
+            return self._retired + sum(c.value for c in live)
+
+    def snapshot(self) -> dict:
+        return {"kind": "counter", "value": self.value()}
+
+    def render(self) -> list[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} counter",
+                f"{self.name} {_fmt(self.value())}"]
+
+
+class Gauge:
+    """Last-write-wins instantaneous value; ``set`` is one attribute store
+    (atomic under the GIL)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, value) -> None:
+        self._value = value
+
+    def value(self):
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"kind": "gauge", "value": self._value}
+
+    def render(self) -> list[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt(self._value)}"]
+
+
+class _DistCell:
+    __slots__ = ("buckets", "sum", "count", "min", "max", "thread_ref")
+
+    def __init__(self, nbuckets: int):
+        self.buckets = [0] * (nbuckets + 1)  # +1: the +Inf overflow bucket
+        self.sum = 0.0
+        self.count = 0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.thread_ref = weakref.ref(threading.current_thread())
+
+
+class Distribution:
+    """Mergeable fixed-bucket histogram with log-spaced bounds
+    (``lo * growth**i`` for i in [0, buckets)) and interpolated
+    p50/p90/p99 estimates; rendered as a Prometheus histogram.
+
+    ``record`` increments a per-thread bucket array via ``bisect`` — no
+    lock, no device sync.  ``merge`` folds a foreign ``snapshot()`` dict
+    (same bounds) into this instance, so worker-side distributions can be
+    rolled up on a coordinator."""
+
+    kind = "distribution"
+
+    def __init__(self, name: str, help: str = "", lo: float = 1e-4,
+                 growth: float = 2.0, buckets: int = 30):
+        self.name = name
+        self.help = help
+        self.bounds = [lo * growth ** i for i in range(buckets)]
+        self._local = threading.local()
+        self._cells: list[_DistCell] = []
+        self._merged: Optional[_DistCell] = None  # cross-process roll-ups
+        self._lock = threading.Lock()
+
+    def record(self, value) -> None:
+        cell = getattr(self._local, "cell", None)
+        if cell is None:
+            cell = _DistCell(len(self.bounds))
+            with self._lock:
+                self._cells.append(cell)
+            self._local.cell = cell
+        cell.buckets[bisect.bisect_left(self.bounds, value)] += 1
+        cell.sum += value
+        cell.count += 1
+        if value < cell.min:
+            cell.min = value
+        if value > cell.max:
+            cell.max = value
+
+    def _fold(self, into: _DistCell, cell) -> None:
+        for i, n in enumerate(cell.buckets):
+            into.buckets[i] += n
+        into.sum += cell.sum
+        into.count += cell.count
+        into.min = min(into.min, cell.min)
+        into.max = max(into.max, cell.max)
+
+    def _total(self) -> _DistCell:
+        total = _DistCell(len(self.bounds))
+        with self._lock:
+            if self._merged is not None:
+                self._fold(total, self._merged)
+            live = []
+            for c in self._cells:
+                t = c.thread_ref()
+                if t is None or not t.is_alive():
+                    if self._merged is None:
+                        self._merged = _DistCell(len(self.bounds))
+                    self._fold(self._merged, c)
+                    self._fold(total, c)
+                else:
+                    live.append(c)
+                    self._fold(total, c)
+            self._cells = live
+        return total
+
+    def _quantile(self, total: _DistCell, q: float) -> float:
+        if total.count == 0:
+            return 0.0
+        target = q * total.count
+        cum = 0
+        for i, n in enumerate(total.buckets):
+            if n == 0:
+                continue
+            if cum + n >= target:
+                lower = self.bounds[i - 1] if i > 0 else 0.0
+                upper = self.bounds[i] if i < len(self.bounds) else total.max
+                upper = max(upper, lower)
+                frac = (target - cum) / n
+                v = lower + (upper - lower) * frac
+                # interpolation within a bucket can overshoot the largest
+                # observed value (which sits somewhere inside the bucket)
+                return min(v, total.max)
+            cum += n
+        return total.max
+
+    def merge(self, snap: dict) -> None:
+        """Fold a foreign ``snapshot()`` (same bucket bounds) into this
+        distribution — the cross-process merge path."""
+        cell = _DistCell(len(self.bounds))
+        cell.buckets = list(snap["buckets"])
+        if len(cell.buckets) != len(self.bounds) + 1:
+            raise ValueError("bucket layout mismatch in Distribution.merge")
+        cell.sum = snap["sum"]
+        cell.count = snap["count"]
+        cell.min = snap.get("min", float("inf"))
+        cell.max = snap.get("max", float("-inf"))
+        with self._lock:
+            if self._merged is None:
+                self._merged = _DistCell(len(self.bounds))
+            self._fold(self._merged, cell)
+
+    def snapshot(self) -> dict:
+        total = self._total()
+        return {
+            "kind": "distribution",
+            "count": total.count,
+            "sum": total.sum,
+            "min": total.min if total.count else 0.0,
+            "max": total.max if total.count else 0.0,
+            "buckets": list(total.buckets),
+            "bounds": list(self.bounds),
+            "p50": self._quantile(total, 0.50),
+            "p90": self._quantile(total, 0.90),
+            "p99": self._quantile(total, 0.99),
+        }
+
+    def render(self) -> list[str]:
+        total = self._total()
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        for le, n in zip(self.bounds, total.buckets):
+            cum += n
+            lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {cum}')
+        cum += total.buckets[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{self.name}_sum {_fmt(total.sum)}")
+        lines.append(f"{self.name}_count {total.count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named-metric registry with get-or-create semantics; re-registering a
+    name as a different kind raises (one meaning per name, process-wide)."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, cls, help: str, **kwargs):
+        _validate_name(name, cls.kind)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"not {cls.kind}")
+                return m
+            m = cls(name, help, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help)
+
+    def distribution(self, name: str, help: str = "", lo: float = 1e-4,
+                     growth: float = 2.0, buckets: int = 30) -> Distribution:
+        return self._get_or_create(name, Distribution, help, lo=lo,
+                                   growth=growth, buckets=buckets)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = sorted(self._metrics.items())
+        return {name: m.snapshot() for name, m in items}
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: list[str] = []
+        for _name, m in items:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+# ---------------------------------------------------------------- engine set
+# Every engine metric is defined EAGERLY at import so /v1/metrics exposes the
+# full vocabulary (at zero) before any traffic — scrapers see a stable set.
+# Registrations live ONLY here; tools/lint_metric_names.py enforces that.
+
+# scan ingest (exec/prefetch.py counters rolled up per query)
+SCAN_BYTES = REGISTRY.counter(
+    "trino_scan_bytes_total", "host bytes produced by connector scans")
+SCAN_ROWS = REGISTRY.counter(
+    "trino_scan_rows_total", "rows produced by connector scans")
+SCAN_BATCHES = REGISTRY.counter(
+    "trino_scan_batches_total", "raw connector batches scanned")
+SCAN_READ_SECONDS = REGISTRY.counter(
+    "trino_scan_read_seconds_total", "time inside connector get_next_batch")
+SCAN_WAIT_SECONDS = REGISTRY.counter(
+    "trino_scan_consumer_wait_seconds_total",
+    "consumer time blocked on scan prefetch")
+SCAN_GBPS = REGISTRY.gauge(
+    "trino_scan_gb_per_second", "scan ingest GB/s of the last observed query")
+
+# host-sync discipline (exec/syncguard.py deltas)
+SYNC_HOST = REGISTRY.counter(
+    "trino_exec_host_syncs_total", "device->host scalar materializations")
+SYNC_BLOCKING = REGISTRY.counter(
+    "trino_exec_blocking_syncs_total", "host syncs that waited on the device")
+SYNC_HOT_LOOP = REGISTRY.counter(
+    "trino_exec_hot_loop_syncs_total",
+    "blocking syncs inside declared hot regions (want: 0)")
+EXPAND_OVERFLOWS = REGISTRY.counter(
+    "trino_exec_expand_overflows_total",
+    "padded-expand capacity overflows detected on device")
+EXPAND_RETRIES = REGISTRY.counter(
+    "trino_exec_expand_retries_total", "expand re-runs after an overflow")
+
+# resilience (retry_policy=QUERY loop, heartbeats, exchange backoff)
+RES_QUERY_RETRIES = REGISTRY.counter(
+    "trino_resilience_query_retries_total", "query-level retry attempts")
+RES_BACKOFF_WAITS = REGISTRY.counter(
+    "trino_resilience_backoff_waits_total", "retry backoff sleeps")
+RES_BACKOFF_SECONDS = REGISTRY.counter(
+    "trino_resilience_backoff_seconds_total", "total retry backoff time")
+RES_BLACKLISTED = REGISTRY.counter(
+    "trino_resilience_blacklisted_workers_total",
+    "workers blacklisted by the query retry loop")
+RES_REPLACEMENTS = REGISTRY.counter(
+    "trino_resilience_worker_replacements_total",
+    "GONE workers replaced by respawn")
+RES_HEARTBEAT_TRANSITIONS = REGISTRY.counter(
+    "trino_resilience_heartbeat_transitions_total",
+    "worker heartbeat state transitions")
+RES_EXCHANGE_FETCH_FAILURES = REGISTRY.counter(
+    "trino_resilience_exchange_fetch_failures_total",
+    "transient exchange fetch failures")
+RES_EXCHANGE_BACKOFF_TRIPS = REGISTRY.counter(
+    "trino_resilience_exchange_backoff_trips_total",
+    "exchange sources declared failed past the failure-duration budget")
+
+# whole-stage compilation (execution/stage_compiler.py)
+FUSED_STAGES = REGISTRY.counter(
+    "trino_fused_stages_total", "fused stage seams executed")
+FUSED_BATCHES = REGISTRY.counter(
+    "trino_fused_batches_total", "input batches absorbed by fused stages")
+FUSED_JIT_CALLS = REGISTRY.counter(
+    "trino_fused_jit_calls_total", "fused accumulate-program dispatches")
+FUSED_COMPILES = REGISTRY.counter(
+    "trino_fused_compiles_total", "distinct (program, bucket) traces")
+FUSED_CACHE_HITS = REGISTRY.counter(
+    "trino_fused_cache_hits_total",
+    "fused dispatches served by an existing trace")
+FUSED_MERGES = REGISTRY.counter(
+    "trino_fused_seam_merges_total", "fused seam merge programs executed")
+FUSED_FALLBACKS = REGISTRY.counter(
+    "trino_fused_fallbacks_total",
+    "fused-stage overflow fallbacks to the legacy path")
+FUSED_COMPILE_SECONDS = REGISTRY.distribution(
+    "trino_fused_compile_seconds",
+    "wall time of fused-program trace+compile dispatches", lo=1e-3)
+
+# exchange HTTP plane (execution/remote.py HttpExchangeClient + worker serve)
+EXCHANGE_BYTES = REGISTRY.counter(
+    "trino_exchange_bytes_total", "exchange page bytes moved over HTTP")
+EXCHANGE_PAGES = REGISTRY.counter(
+    "trino_exchange_pages_total", "exchange pages moved over HTTP")
+EXCHANGE_WAIT_SECONDS = REGISTRY.counter(
+    "trino_exchange_wait_seconds_total",
+    "client time spent inside exchange fetches")
+
+# query/task lifecycle
+QUERIES_STARTED = REGISTRY.counter(
+    "trino_queries_started_total", "queries entered through a runner")
+QUERIES_FINISHED = REGISTRY.counter(
+    "trino_queries_finished_total", "queries that reached FINISHED")
+QUERIES_FAILED = REGISTRY.counter(
+    "trino_queries_failed_total", "queries that reached FAILED")
+QUERY_WALL_SECONDS = REGISTRY.distribution(
+    "trino_query_wall_seconds", "per-query wall time", lo=1e-3)
+TASKS_CREATED = REGISTRY.counter(
+    "trino_tasks_created_total", "tasks started (in-process or worker)")
+TASKS_FAILED = REGISTRY.counter(
+    "trino_tasks_failed_total", "tasks that reached FAILED")
+TASK_WALL_SECONDS = REGISTRY.distribution(
+    "trino_task_wall_seconds", "per-task wall time", lo=1e-3)
+DISPATCHER_QUERIES = REGISTRY.counter(
+    "trino_dispatcher_queries_total",
+    "statements admitted through the HTTP dispatcher")
+
+# device memory watermark (best-effort; jax CPU backends may not report)
+DEVICE_MEMORY_IN_USE = REGISTRY.gauge(
+    "trino_device_memory_bytes_in_use", "allocator bytes in use, all devices")
+DEVICE_MEMORY_PEAK = REGISTRY.gauge(
+    "trino_device_memory_peak_bytes",
+    "allocator peak bytes in use, all devices")
+
+
+# ------------------------------------------------------------ observe hooks
+def observe_scan(ingest) -> None:
+    """Fold a ScanIngestStats roll-up (exec/stats.py) into the registry."""
+    if ingest is None or not ingest.scan_batches:
+        return
+    SCAN_BYTES.inc(ingest.scan_bytes)
+    SCAN_ROWS.inc(ingest.scan_rows)
+    SCAN_BATCHES.inc(ingest.scan_batches)
+    SCAN_READ_SECONDS.inc(ingest.source_read_s)
+    SCAN_WAIT_SECONDS.inc(ingest.consumer_wait_s)
+    if ingest.gbps:
+        SCAN_GBPS.set(round(ingest.gbps, 3))
+
+
+def observe_sync(sync) -> None:
+    """Fold a SyncGuard SyncStats delta (exec/syncguard.py)."""
+    if sync is None:
+        return
+    if sync.host_syncs:
+        SYNC_HOST.inc(sync.host_syncs)
+    if sync.blocking_syncs:
+        SYNC_BLOCKING.inc(sync.blocking_syncs)
+    if sync.hot_loop_syncs:
+        SYNC_HOT_LOOP.inc(sync.hot_loop_syncs)
+    if sync.expand_overflows:
+        EXPAND_OVERFLOWS.inc(sync.expand_overflows)
+    if sync.expand_retries:
+        EXPAND_RETRIES.inc(sync.expand_retries)
+
+
+def observe_resilience(res) -> None:
+    """Fold a ResilienceStats delta (exec/stats.py)."""
+    if res is None or not res.any:
+        return
+    RES_QUERY_RETRIES.inc(res.query_retries)
+    RES_BACKOFF_WAITS.inc(res.backoff_waits)
+    RES_BACKOFF_SECONDS.inc(res.backoff_wait_s)
+    RES_BLACKLISTED.inc(res.blacklisted_workers)
+    RES_REPLACEMENTS.inc(res.worker_replacements)
+    RES_HEARTBEAT_TRANSITIONS.inc(res.heartbeat_transitions)
+    RES_EXCHANGE_FETCH_FAILURES.inc(res.exchange_fetch_failures)
+    RES_EXCHANGE_BACKOFF_TRIPS.inc(res.exchange_backoff_trips)
+
+
+def observe_fused(fs) -> None:
+    """Fold a FusedStageStats roll-up.  ``compiles`` is deliberately NOT
+    added here: the compile site (execution/stage_compiler.py) records it
+    directly, together with the compile-wall-time histogram."""
+    if fs is None or not fs.any:
+        return
+    FUSED_STAGES.inc(fs.stages)
+    FUSED_BATCHES.inc(fs.batches)
+    FUSED_JIT_CALLS.inc(fs.jit_calls)
+    FUSED_CACHE_HITS.inc(fs.cache_hits)
+    FUSED_MERGES.inc(fs.merges)
+    FUSED_FALLBACKS.inc(fs.fallbacks)
+
+
+def observe_exchange(nbytes: int, pages: int, wait_s: float) -> None:
+    """One exchange fetch/serve observation (HTTP plane)."""
+    EXCHANGE_BYTES.inc(nbytes)
+    EXCHANGE_PAGES.inc(pages)
+    EXCHANGE_WAIT_SECONDS.inc(wait_s)
+
+
+def update_device_memory_watermark() -> Optional[int]:
+    """Refresh the device-memory gauges from the jax allocator stats
+    (best-effort: CPU backends often report nothing → None).  Allocator
+    stats are a host-side query, not a device sync."""
+    try:
+        import jax
+
+        in_use = peak = 0
+        found = False
+        for d in jax.devices():
+            stats = getattr(d, "memory_stats", None)
+            stats = stats() if callable(stats) else None
+            if not stats:
+                continue
+            found = True
+            in_use += stats.get("bytes_in_use", 0)
+            peak += stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0))
+    except Exception:
+        return None
+    if not found:
+        return None
+    DEVICE_MEMORY_IN_USE.set(in_use)
+    DEVICE_MEMORY_PEAK.set(peak)
+    return peak

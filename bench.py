@@ -24,7 +24,9 @@ An unusable accelerator backend falls back to JAX_PLATFORMS=cpu instead of
 failing (subprocess device probe, same pattern as __graft_entry__).
 
 Subcommands: ``--scan`` (ingest microbench), ``--ndv [1e3,1e4,...]``
-(TRINO_TPU_HASH_IMPL hash-vs-sort NDV-ladder bake-off, see run_ndv_bench).
+(TRINO_TPU_HASH_IMPL hash-vs-sort NDV-ladder bake-off, see run_ndv_bench),
+``--qps`` (two-tenant weighted-fair sustained-load harness + OOM drill,
+see run_qps_bench; BENCH_QPS_DURATION/BENCH_QPS_SF/BENCH_QPS_CLIENTS).
 """
 
 from __future__ import annotations
@@ -183,6 +185,221 @@ def _time_queries(runner, iters: int) -> dict[str, float]:
         samples.sort()
         times[name] = samples[len(samples) // 2]
     return times
+
+
+def _build_qps_plane(catalog, workers: int = 2, root_slots: int = 4,
+                     heavy_weight: int = 3, light_weight: int = 1,
+                     memory_capacity=None):
+    """Two-tenant serving plane: ONE weighted-fair DispatchManager + ONE
+    ClusterMemoryManager shared by two runners whose sessions differ only in
+    ``source`` — the selector routes heavy/light traffic into sibling groups
+    competing for ``root_slots`` concurrency slots at weights 3:1."""
+    from trino_tpu.execution.control import DispatchManager
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.execution.resource_manager import (
+        ClusterMemoryManager,
+        ResourceGroup,
+    )
+    from trino_tpu.runner import Session
+
+    root = ResourceGroup("global", hard_concurrency_limit=root_slots,
+                         scheduling_policy="weighted_fair", max_queued=1000)
+    root.subgroup("heavy", weight=heavy_weight,
+                  hard_concurrency_limit=root_slots)
+    root.subgroup("light", weight=light_weight,
+                  hard_concurrency_limit=root_slots)
+    dispatcher = DispatchManager(
+        root, selector=lambda sql, s: getattr(s, "source", ""))
+    mm = ClusterMemoryManager(capacity_bytes=memory_capacity)
+    runners = {}
+    for name in ("heavy", "light"):
+        r = DistributedQueryRunner(
+            catalog, worker_count=workers,
+            session=Session(default_catalog="memory", source=name,
+                            node_count=workers))
+        r.dispatcher = dispatcher
+        r.memory_manager = mm
+        runners[name] = r
+    return root, dispatcher, mm, runners
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def run_qps_sustained(duration_s: float, catalog, clients_per_group: int = 5,
+                      sql: str = None) -> dict:
+    """The sustained-load leg: closed-loop clients per tenant hammer the
+    shared admission plane for ``duration_s``; returns completed-work
+    counts, per-group latency/queue-wait percentiles, queue depth and kill
+    counts.  Saturation (clients > root slots) is what makes the
+    completed-work ratio track the 3:1 configured weights."""
+    import threading
+
+    from trino_tpu.telemetry import runtime as rt
+
+    sql = sql or Q1
+    root, dispatcher, mm, runners = _build_qps_plane(catalog)
+    for r in runners.values():
+        r.execute(sql)  # warmup: compile outside the measured window
+    stop = threading.Event()
+    done: dict[str, list] = {"heavy": [], "light": []}
+    failed = {"heavy": 0, "light": 0}
+
+    def client(group: str):
+        r = runners[group]
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                r.execute(sql)
+            except Exception:
+                failed[group] += 1
+                continue
+            done[group].append(time.perf_counter() - t0)
+
+    depth: list[int] = []
+
+    def monitor():
+        while not stop.is_set():
+            depth.append(root.queued_total)
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=client, args=(g,), daemon=True)
+               for g in ("heavy", "light") for _ in range(clients_per_group)]
+    threads.append(threading.Thread(target=monitor, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    waits = {"heavy": [], "light": []}
+    for q in rt.queries():
+        g = q.resource_group.rsplit(".", 1)[-1]
+        if g in waits:
+            waits[g].append(q.queued_ms)
+    out = {"duration_s": duration_s,
+           "clients_per_group": clients_per_group,
+           "weights": {"heavy": 3, "light": 1},
+           "queue_depth_max": max(depth, default=0),
+           "queue_depth_mean": round(sum(depth) / len(depth), 2)
+           if depth else 0.0,
+           "oom_kills": mm.oom_kills}
+    for g in ("heavy", "light"):
+        lat = sorted(done[g])
+        qw = sorted(waits[g])
+        out[g] = {"completed": len(lat), "failed": failed[g],
+                  "latency_p50_ms": round(_pct(lat, 0.50) * 1e3, 1),
+                  "latency_p99_ms": round(_pct(lat, 0.99) * 1e3, 1),
+                  "queue_wait_p50_ms": round(_pct(qw, 0.50), 1),
+                  "queue_wait_p99_ms": round(_pct(qw, 0.99), 1)}
+    light = max(1, out["light"]["completed"])
+    out["fairness_ratio"] = round(out["heavy"]["completed"] / light, 3)
+    return out
+
+
+def run_qps_oom_drill(catalog, capacity_bytes: int = 64 << 20,
+                      pressure_bytes: int = 256 << 20,
+                      timeout_s: float = 60.0) -> dict:
+    """The OOM-killer drill: a capped ClusterMemoryManager, one running
+    query, and a synthetic worker snapshot attributing ``pressure_bytes``
+    to it — the killer's actual input plane is worker /v1/status JSON, so
+    injecting a snapshot exercises the real kill path end to end: the
+    drain loop polls the handle, raises CLUSTER_OUT_OF_MEMORY, and a
+    follow-up query completes once the pressure clears."""
+    import threading
+
+    from trino_tpu.spi.errors import TrinoError
+
+    root, dispatcher, mm, runners = _build_qps_plane(
+        catalog, memory_capacity=capacity_bytes)
+    mm.enforce_interval_s = 0.0  # drill: enforce on every poll
+    r = runners["heavy"]
+    r.execute(Q1)  # warmup
+    result: dict = {}
+
+    def victim():
+        try:
+            for _ in range(2000):  # long enough for the kill to land
+                r.execute(Q1)
+            result["error"] = None
+        except TrinoError as e:
+            result["error"] = e.code.name
+        except Exception as e:  # pragma: no cover - diagnostic
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=victim, daemon=True)
+    th.start()
+    # keep pressure on whichever query is registered right now until a kill
+    # lands (a query finishing between sweeps takes its accounting with it)
+    deadline = time.monotonic() + timeout_s
+    killed = False
+    while not killed and time.monotonic() < deadline:
+        with mm._lock:
+            live = list(mm._handles.values())
+        if live:
+            h = live[0]
+            mm.update_worker("synthetic-pressure", {"tasks": {
+                "t0": {"query_id": h.query_id,
+                       "memory_reserved_bytes": pressure_bytes}}})
+            mm.enforce()
+            killed = h.killed
+        time.sleep(0.005)
+    th.join(timeout=timeout_s)
+    hung = th.is_alive()
+    # pressure clears with the worker snapshot; steady state must return
+    mm.forget_worker("synthetic-pressure")
+    post_ok = False
+    if not hung:
+        try:
+            runners["light"].execute(Q1)
+            post_ok = True
+        except Exception:
+            post_ok = False
+    return {"capacity_bytes": capacity_bytes,
+            "pressure_bytes": pressure_bytes,
+            "victim_error": result.get("error"),
+            "victim_hung": hung,
+            "oom_kills": mm.oom_kills,
+            "post_drill_query_ok": post_ok}
+
+
+def run_qps_bench(duration_s: float = None, sf: float = None,
+                  clients_per_group: int = None, write: bool = True) -> dict:
+    """``bench.py --qps``: the multi-tenant serving benchmark.  Two resource
+    groups at 3:1 weights under saturating closed-loop load (acceptance:
+    completed-work ratio within +-25% of 3.0, bounded light-group queue
+    wait), then the capped-memory OOM drill.  Writes BENCH_r08.json."""
+    duration_s = duration_s if duration_s is not None else float(
+        os.environ.get("BENCH_QPS_DURATION", "30"))
+    sf = sf if sf is not None else float(
+        os.environ.get("BENCH_QPS_SF", "0.05"))
+    clients_per_group = clients_per_group or int(
+        os.environ.get("BENCH_QPS_CLIENTS", "5"))
+    _ensure_backend()
+    _enable_compile_cache()
+    catalog = _stage_memory_tables(sf)
+    sustained = run_qps_sustained(duration_s, catalog,
+                                  clients_per_group=clients_per_group)
+    drill = run_qps_oom_drill(catalog)
+    result = {
+        "metric": f"qps_two_group_weighted_fair_sf{sf:g}",
+        "value": sustained["fairness_ratio"],
+        "unit": "heavy/light completed ratio (target 3.0 +-25%)",
+        "sustained": sustained,
+        "oom_drill": drill,
+    }
+    print(json.dumps(result))
+    if write:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r08.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
 
 
 def run_baseline() -> None:
@@ -691,6 +908,9 @@ def main() -> None:
         return
     if "--fused" in sys.argv:
         run_fused_bench()
+        return
+    if "--qps" in sys.argv:
+        run_qps_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

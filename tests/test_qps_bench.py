@@ -1,0 +1,52 @@
+"""bench.py --qps: the two-tenant sustained-load harness + OOM drill.
+
+The fast leg runs a seconds-scale slice of the harness end to end (real
+runners, real admission plane) and asserts the RESULT SHAPE plus the OOM
+drill's hard guarantees; the statistical fairness acceptance (3:1 +-25%)
+needs a longer window and runs as the slow ladder."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_catalog():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return bench._stage_memory_tables(0.01)
+
+
+def test_qps_smoke_structure_and_drill(tiny_catalog):
+    sustained = bench.run_qps_sustained(2.0, tiny_catalog,
+                                        clients_per_group=2)
+    for g in ("heavy", "light"):
+        assert sustained[g]["completed"] > 0
+        assert sustained[g]["failed"] == 0
+        assert sustained[g]["latency_p99_ms"] >= sustained[g]["latency_p50_ms"]
+    assert sustained["fairness_ratio"] > 0
+    assert sustained["queue_depth_max"] >= 0
+
+    drill = bench.run_qps_oom_drill(tiny_catalog)
+    assert drill["victim_error"] == "CLUSTER_OUT_OF_MEMORY"
+    assert not drill["victim_hung"]
+    assert drill["oom_kills"] >= 1
+    assert drill["post_drill_query_ok"]
+
+
+@pytest.mark.slow
+def test_qps_full_ladder_fairness(tiny_catalog):
+    """The acceptance leg: saturating 3:1 run converges to the configured
+    share within +-25% with bounded light-group queue wait."""
+    sustained = bench.run_qps_sustained(20.0, tiny_catalog,
+                                        clients_per_group=5)
+    assert 3.0 * 0.75 <= sustained["fairness_ratio"] <= 3.0 * 1.25, sustained
+    assert sustained["light"]["completed"] > 0
+    # light p99 queue wait bounded: under weighted fair the light tenant
+    # waits at most a few service times, never unboundedly
+    assert sustained["light"]["queue_wait_p99_ms"] < 20_000

@@ -1,0 +1,525 @@
+"""Multi-tenant serving plane: weighted-fair resource groups, cluster
+memory manager + OOM killer, memory-aware admission
+(execution/resource_manager.py, spi/session.py)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from trino_tpu.execution.control import DispatchManager
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.execution.resource_manager import (
+    ClusterMemoryManager,
+    ResourceGroup,
+    build_group_tree,
+    estimate_peak_memory,
+    find_group,
+)
+from trino_tpu.runner import Session
+from trino_tpu.spi.errors import (
+    CLUSTER_OUT_OF_MEMORY,
+    EXCEEDED_GLOBAL_MEMORY_LIMIT,
+    QUERY_QUEUE_FULL,
+    QUERY_QUEUED_TIMEOUT,
+    TrinoError,
+    classify,
+)
+from trino_tpu.spi.session import GroupSelector
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ----------------------------------------------------------- config parsing
+
+
+def test_build_group_tree_from_json():
+    spec = json.dumps({
+        "root": {
+            "name": "global", "hard_concurrency_limit": 10,
+            "scheduling_policy": "weighted_fair",
+            "subgroups": [
+                {"name": "etl", "weight": 3, "max_queued": 7,
+                 "soft_memory_limit_bytes": 1 << 30},
+                {"name": "adhoc", "weight": 1,
+                 "soft_concurrency_limit": 2},
+            ],
+        },
+        "selectors": [
+            {"user": "etl_.*", "group": "etl"},
+            {"source": "dashboard", "group": "adhoc"},
+            {"group": ""},
+        ],
+    })
+    root, selector = build_group_tree(spec)
+    assert root.hard_concurrency_limit == 10
+    assert root.scheduling_policy == "weighted_fair"
+    etl = root.children["etl"]
+    assert (etl.name, etl.weight, etl.max_queued) == ("global.etl", 3, 7)
+    assert etl.soft_memory_limit_bytes == 1 << 30
+    assert root.children["adhoc"].soft_concurrency_limit == 2
+
+    class S:
+        user = "etl_nightly"
+        source = ""
+    assert selector("select 1", S()) == "etl"
+    S.user, S.source = "alice", "dashboard"
+    assert selector("select 1", S()) == "adhoc"
+    S.source = "cli"
+    assert selector("select 1", S()) == ""  # catch-all -> root
+
+
+def test_selector_sql_regex_and_missing_group_rejected():
+    sel = GroupSelector.from_spec(
+        [{"sql": r"(?i)insert\s", "group": "writes"}, {"group": "other"}])
+
+    class S:
+        pass
+    assert sel.select("INSERT into t values (1)", S()) == "writes"
+    assert sel.select("select 1", S()) == "other"
+    with pytest.raises(ValueError):
+        GroupSelector.from_spec([{"user": "x"}])
+
+
+def test_find_group_dotted_path():
+    root = ResourceGroup("global")
+    sub = root.subgroup("etl").subgroup("nightly")
+    assert find_group(root, "global.etl.nightly") is sub
+    assert find_group(root, "") is None
+    assert find_group(root, "nope") is None
+
+
+# ------------------------------------------------- scheduling policies
+
+
+def _churn(group, counts, key, stop, work_s=0.002):
+    while not stop.is_set():
+        try:
+            group.acquire(timeout=5.0)
+        except TrinoError:
+            continue
+        try:
+            time.sleep(work_s)
+            counts[key] += 1
+        finally:
+            group.release()
+
+
+def test_weighted_fair_converges_to_share_without_starvation():
+    """Under saturation a 3:1 weighted pair completes work 3:1 (+-25%)
+    and the light tenant is never starved."""
+    root = ResourceGroup("global", hard_concurrency_limit=4,
+                         scheduling_policy="weighted_fair")
+    heavy = root.subgroup("heavy", weight=3)
+    light = root.subgroup("light", weight=1)
+    counts = {"heavy": 0, "light": 0}
+    stop = threading.Event()
+    threads = [threading.Thread(target=_churn,
+                                args=(g, counts, k, stop), daemon=True)
+               for g, k in ((heavy, "heavy"), (light, "light"))
+               for _ in range(5)]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert counts["light"] > 0, "light tenant starved"
+    ratio = counts["heavy"] / counts["light"]
+    assert 3.0 * 0.75 <= ratio <= 3.0 * 1.25, (counts, ratio)
+
+
+def test_fair_policy_is_fifo():
+    """The pre-existing contract: under the default fair policy queued
+    queries admit in global arrival order."""
+    g = ResourceGroup("global", hard_concurrency_limit=1)
+    g.acquire()
+    order = []
+
+    def waiter(i):
+        g.acquire(timeout=10)
+        order.append(i)
+        g.release()
+
+    threads = []
+    for i in range(3):
+        t = threading.Thread(target=waiter, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)  # deterministic arrival order
+    g.release()
+    for t in threads:
+        t.join(timeout=10)
+    assert order == [0, 1, 2]
+
+
+def test_query_priority_policy_admits_highest_first():
+    g = ResourceGroup("global", hard_concurrency_limit=1,
+                      scheduling_policy="query_priority")
+    g.acquire()
+    order = []
+
+    def waiter(i, prio):
+        g.acquire(timeout=10, priority=prio)
+        order.append(i)
+
+    threads = []
+    for i, prio in enumerate([1, 5, 3]):
+        t = threading.Thread(target=waiter, args=(i, prio), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)
+    # release one slot at a time; each wakes exactly one waiter
+    for _ in range(3):
+        g.release()
+        time.sleep(0.1)
+    for t in threads:
+        t.join(timeout=10)
+    assert order == [1, 2, 0]  # prio 5, then 3, then 1
+
+
+def test_cpu_quota_blocks_and_regenerates():
+    clock = FakeClock()
+    g = ResourceGroup("global", hard_concurrency_limit=4,
+                      hard_cpu_limit_s=1.0,
+                      cpu_quota_generation_s_per_s=0.5, clock=clock)
+    g.acquire()
+    g.release(cpu_s=2.0)  # blow the quota
+    with pytest.raises(TrinoError) as ei:
+        g.acquire(timeout=0.05)
+    assert ei.value.code is QUERY_QUEUED_TIMEOUT
+    clock.t += 4.0  # regenerates 2.0s of quota -> usage back to 0
+    g.refresh()
+    g.acquire(timeout=1.0)  # admitted again
+    g.release()
+
+
+def test_soft_cpu_limit_scales_concurrency():
+    clock = FakeClock()
+    g = ResourceGroup("global", hard_concurrency_limit=4,
+                      soft_cpu_limit_s=1.0, hard_cpu_limit_s=3.0,
+                      clock=clock)
+    g.acquire()
+    g.release(cpu_s=2.0)  # halfway between soft and hard -> limit 2
+    g.acquire()
+    g.acquire()
+    with pytest.raises(TrinoError):
+        g.acquire(timeout=0.05)
+
+
+# ------------------------------------------- admission rejection errors
+
+
+def test_queue_full_is_user_error_and_runtimeerror():
+    g = ResourceGroup("global", hard_concurrency_limit=1, max_queued=0)
+    g.acquire()
+    with pytest.raises(RuntimeError):  # historical contract
+        g.acquire(timeout=0.05)
+    g2 = ResourceGroup("g2", hard_concurrency_limit=1, max_queued=0)
+    g2.acquire()
+    with pytest.raises(TrinoError) as ei:
+        g2.acquire(timeout=0.05)
+    err = ei.value
+    assert err.code is QUERY_QUEUE_FULL
+    assert err.error_type == "USER"
+    assert classify(err) is err
+
+
+def test_queued_timeout_is_user_error():
+    g = ResourceGroup("global", hard_concurrency_limit=1, max_queued=10)
+    g.acquire()
+    t0 = time.monotonic()
+    with pytest.raises(TrinoError) as ei:
+        g.acquire(timeout=0.1)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.code is QUERY_QUEUED_TIMEOUT
+    assert ei.value.error_type == "USER"
+    assert g.queued == 0  # timed-out ticket left the queue
+
+
+def test_queue_full_not_retried_under_query_retry_policy():
+    """A USER admission rejection must surface immediately — the query
+    retry loop re-running it would just re-fail (and double-bill)."""
+    runner = DistributedQueryRunner(
+        worker_count=2,
+        session=Session(retry_policy="QUERY", query_concurrency=1,
+                        query_max_queued=0, node_count=2))
+    runner.dispatcher.root.acquire()  # occupy the only slot
+    try:
+        with pytest.raises(TrinoError) as ei:
+            runner.execute("select count(*) from nation")
+        assert ei.value.code is QUERY_QUEUE_FULL
+        assert runner.resilience.query_retries == 0
+    finally:
+        runner.dispatcher.root.release()
+
+
+# --------------------------------------------------- cluster memory manager
+
+
+def _mk_handles(mm, specs):
+    """specs: [(qid, priority, usage_bytes)] -> handles + synthetic usage."""
+    handles = {}
+    tasks = {}
+    for i, (qid, prio, usage) in enumerate(specs):
+        handles[qid] = mm.register_query(qid, priority=prio)
+        tasks[f"t{i}"] = {"query_id": qid, "memory_reserved_bytes": usage}
+    mm.update_worker("w0", {"tasks": tasks})
+    return handles
+
+
+@pytest.mark.parametrize("policy,victim", [
+    ("largest_query", "big"),
+    ("lowest_priority", "low"),
+    ("youngest", "young"),
+])
+def test_oom_victim_policy_selection(policy, victim):
+    mm = ClusterMemoryManager(capacity_bytes=100, oom_policy=policy,
+                              enforce_interval_s=0.0)
+    handles = _mk_handles(mm, [
+        ("big", 5, 80),     # largest reservation
+        ("low", 1, 50),     # lowest priority
+        ("young", 9, 40),   # registered last
+    ])
+    killed = mm.enforce()
+    assert killed[0] == victim
+    err = handles[victim].killed_error()
+    assert err is not None and err.code is CLUSTER_OUT_OF_MEMORY
+    assert err.error_type == "INSUFFICIENT_RESOURCES"
+
+
+def test_oom_killer_skips_zero_usage_and_stops_when_fitting():
+    mm = ClusterMemoryManager(capacity_bytes=100,
+                              oom_policy="lowest_priority",
+                              enforce_interval_s=0.0)
+    handles = _mk_handles(mm, [
+        ("idle", 0, 0),    # lowest priority but reserves nothing
+        ("mid", 5, 90),
+        ("top", 9, 60),
+    ])
+    killed = mm.enforce()
+    # killing idle frees nothing -> skipped; killing mid (90) fits 60<=100
+    assert killed == ["mid"]
+    assert not handles["idle"].killed and not handles["top"].killed
+    assert mm.oom_kills == 1
+
+
+def test_per_query_max_memory_kill():
+    mm = ClusterMemoryManager(capacity_bytes=None, enforce_interval_s=0.0)
+    h = mm.register_query("q1", max_memory=10)
+    mm.update_worker("w0", {"tasks": {
+        "t0": {"query_id": "q1", "memory_reserved_bytes": 50}}})
+    mm.enforce()
+    err = h.killed_error()
+    assert err is not None and err.code is EXCEEDED_GLOBAL_MEMORY_LIMIT
+
+
+def test_worker_snapshot_replacement_and_pool_weakref():
+    from trino_tpu.spi.memory import MemoryPool
+
+    mm = ClusterMemoryManager(capacity_bytes=None)
+    mm.register_query("q1")
+    mm.update_worker("w0", {"tasks": {
+        "t0": {"query_id": "q1", "memory_reserved_bytes": 70}}})
+    pool = MemoryPool("hbm", 1 << 30)
+    pool.reserve(30)
+    mm.register_pool("q1", pool)
+    assert mm.reserved_by_query() == {"q1": 100}
+    # a fresh snapshot replaces the node's view wholesale
+    mm.update_worker("w0", {"tasks": {}})
+    assert mm.reserved_by_query() == {"q1": 30}
+    del pool  # pool dies with its task -> accounting follows
+    assert mm.reserved_by_query() == {}
+
+
+def test_group_memory_rollup_blocks_admission():
+    root = ResourceGroup("global", hard_concurrency_limit=8)
+    etl = root.subgroup("etl", soft_memory_limit_bytes=100)
+    mm = ClusterMemoryManager(capacity_bytes=None, enforce_interval_s=0.0)
+    mm.register_query("q1", group=etl)
+    mm.update_worker("w0", {"tasks": {
+        "t0": {"query_id": "q1", "memory_reserved_bytes": 150}}})
+    mm.enforce()
+    assert etl.memory_usage_bytes == 150
+    assert root.memory_usage_bytes == 150  # rolls up to ancestors
+    with pytest.raises(TrinoError):  # over the soft limit -> hold new work
+        etl.acquire(timeout=0.05)
+    mm.update_worker("w0", {"tasks": {}})
+    mm.enforce()
+    etl.acquire(timeout=1.0)  # headroom returned -> admitted
+    etl.release()
+
+
+# ------------------------------------------------ killed queries end to end
+
+
+def _pressure_once(mm, pressure_bytes, done):
+    """Kill exactly one registered query via a synthetic worker snapshot,
+    then clear the pressure (bench.py's drill pattern)."""
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with mm._lock:
+            live = list(mm._handles.values())
+        if live:
+            h = live[0]
+            mm.update_worker("pressure", {"tasks": {
+                "t0": {"query_id": h.query_id,
+                       "memory_reserved_bytes": pressure_bytes}}})
+            mm.enforce()
+            if h.killed:
+                break
+        time.sleep(0.002)
+    mm.forget_worker("pressure")
+    done.set()
+
+
+def test_oom_kill_surfaces_cluster_out_of_memory():
+    """The killer fires mid-query, the victim fails with
+    CLUSTER_OUT_OF_MEMORY (no hang), and the next query completes."""
+    runner = DistributedQueryRunner(worker_count=2,
+                                    session=Session(node_count=2))
+    runner.memory_manager = ClusterMemoryManager(capacity_bytes=64 << 20,
+                                                 enforce_interval_s=0.0)
+    done = threading.Event()
+    th = threading.Thread(target=_pressure_once,
+                          args=(runner.memory_manager, 256 << 20, done),
+                          daemon=True)
+    th.start()
+    with pytest.raises(TrinoError) as ei:
+        for _ in range(2000):
+            runner.execute("select count(*) from lineitem")
+    assert ei.value.code is CLUSTER_OUT_OF_MEMORY
+    assert done.wait(30)
+    # steady state returns: the cluster runs queries again
+    r = runner.execute("select count(*) from nation")
+    assert r.rows()[0][0] == 25
+
+
+def test_oom_killed_query_reruns_under_query_retry():
+    """CLUSTER_OUT_OF_MEMORY is INSUFFICIENT_RESOURCES -> retryable: with
+    retry_policy=QUERY the killed attempt re-runs and succeeds once the
+    memory pressure clears."""
+    runner = DistributedQueryRunner(
+        worker_count=2,
+        session=Session(retry_policy="QUERY", query_retry_attempts=3,
+                        retry_initial_delay_s=0.01, node_count=2))
+    runner.memory_manager = ClusterMemoryManager(capacity_bytes=64 << 20,
+                                                 enforce_interval_s=0.0)
+    done = threading.Event()
+    th = threading.Thread(target=_pressure_once,
+                          args=(runner.memory_manager, 256 << 20, done),
+                          daemon=True)
+    th.start()
+    r = runner.execute("select count(*) from nation")
+    assert r.rows()[0][0] == 25
+    assert done.wait(30)
+    assert runner.resilience.query_retries >= 1
+    th.join(timeout=10)
+
+
+# ------------------------------------------------ memory-aware admission
+
+
+def test_estimate_peak_memory_from_history():
+    from trino_tpu.telemetry import runtime as rt
+
+    sql = "select 'estimate-probe-xyz' as c"
+    fp = rt.fingerprint(sql)
+    for peak in (100, 500, 300):
+        rec = rt.query_started("qh", sql, "u")
+        rt.query_finished(rec, "FINISHED", 1.0, 1.0, 1,
+                          peak_memory_bytes=peak)
+    assert estimate_peak_memory(fp, 42) == 500  # max of recent, not mean
+    assert estimate_peak_memory(rt.fingerprint("select 2, 3"), 42) == 42
+    # fingerprint normalizes whitespace/case
+    assert rt.fingerprint("SELECT   'estimate-probe-xyz' AS c  ") == fp
+
+
+def test_dispatcher_memory_aware_admission_times_out():
+    from trino_tpu.server.protocol import QueryDispatcher, _Query
+
+    class StubRunner:
+        memory_manager = ClusterMemoryManager(capacity_bytes=100,
+                                              enforce_interval_s=1e9)
+        session = Session(query_queued_timeout_s=0.2)
+    StubRunner.memory_manager.update_worker("w0", {"tasks": {
+        "t0": {"query_id": "hog", "memory_reserved_bytes": 100}}})
+    d = QueryDispatcher.__new__(QueryDispatcher)
+    d.runner = StubRunner()
+    q = _Query("qid1", "select 1")
+    with pytest.raises(TrinoError) as ei:
+        d._await_memory(q)
+    assert ei.value.code is QUERY_QUEUED_TIMEOUT
+    # cancellation exits the wait without error
+    q.cancelled = True
+    d._await_memory(q)
+
+
+# ------------------------------------------------- system tables + metrics
+
+
+def test_system_resource_groups_and_queued_time():
+    spec = json.dumps({
+        "root": {"name": "global", "hard_concurrency_limit": 8,
+                 "scheduling_policy": "weighted_fair",
+                 "subgroups": [{"name": "etl", "weight": 3}]},
+        "selectors": [{"group": "etl"}],
+    })
+    root, selector = build_group_tree(spec)
+    runner = DistributedQueryRunner(worker_count=2,
+                                    session=Session(node_count=2))
+    runner.dispatcher = DispatchManager(root, selector)
+    runner.execute("select count(*) from nation")
+    rows = runner.execute(
+        "select path, policy, weight, running, queued "
+        "from system.runtime.resource_groups").rows()
+    by_path = {r[0]: r for r in rows}
+    assert by_path["global"][1] == "weighted_fair"
+    assert by_path["global.etl"][2] == 3
+    assert by_path["global"][3] >= 1  # the introspection query itself
+    qrows = runner.execute(
+        "select state, queued_time_ms, resource_group "
+        "from system.runtime.queries").rows()
+    fin = [r for r in qrows if r[0] == "FINISHED" and r[2] == "global.etl"]
+    assert fin and all(r[1] >= 0.0 for r in fin)
+
+
+def test_serving_metrics_registered():
+    from trino_tpu.telemetry.metrics import REGISTRY
+
+    g = ResourceGroup("mtest")
+    g.acquire()
+    g.release()
+    mm = ClusterMemoryManager(capacity_bytes=100, enforce_interval_s=0.0)
+    mm.enforce()
+    snap = REGISTRY.snapshot()
+    assert snap["trino_admission_queued_seconds"]["kind"] == "distribution"
+    assert snap["trino_oom_kills_total"]["kind"] == "counter"
+    assert "trino_cluster_memory_reserved_bytes" in snap
+    assert "trino_cluster_memory_free_bytes" in snap
+    assert snap["trino_resource_group_running_mtest"]["value"] == 0
+    assert "trino_resource_group_queued_mtest" in snap
+
+
+def test_build_dispatch_manager_env_config(monkeypatch):
+    from trino_tpu.execution.resource_manager import build_dispatch_manager
+
+    spec = json.dumps({
+        "root": {"name": "global", "hard_concurrency_limit": 3},
+        "selectors": [{"source": "etl", "group": "batch"}],
+    })
+    monkeypatch.setenv("TRINO_TPU_RESOURCE_GROUPS", spec)
+    dm = build_dispatch_manager(Session())
+    assert dm.root.hard_concurrency_limit == 3
+    assert dm._group_for("select 1", Session(source="etl")).name \
+        == "global.batch"
+    monkeypatch.delenv("TRINO_TPU_RESOURCE_GROUPS")
+    dm = build_dispatch_manager(Session(query_concurrency=7))
+    assert dm.root.hard_concurrency_limit == 7

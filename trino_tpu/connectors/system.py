@@ -47,6 +47,14 @@ _TABLES = {
         ("output_rows", BIGINT), ("input_rows", BIGINT),
         ("input_bytes", BIGINT), ("retry_count", BIGINT),
         ("peak_memory_bytes", BIGINT), ("error", VARCHAR),
+        ("queued_time_ms", DOUBLE), ("resource_group", VARCHAR),
+    ]),
+    "runtime.resource_groups": _schema("runtime.resource_groups", [
+        ("path", VARCHAR), ("policy", VARCHAR), ("weight", BIGINT),
+        ("soft_concurrency_limit", BIGINT),
+        ("hard_concurrency_limit", BIGINT), ("max_queued", BIGINT),
+        ("running", BIGINT), ("queued", BIGINT),
+        ("memory_bytes", BIGINT), ("cpu_usage_s", DOUBLE),
     ]),
     "runtime.tasks": _schema("runtime.tasks", [
         ("query_id", VARCHAR), ("task_id", VARCHAR), ("fragment", BIGINT),
@@ -118,7 +126,7 @@ class SystemConnector(Connector):
             out = [
                 (q.query_id, q.state, q.user, q.sql, q.wall_ms, q.cpu_ms,
                  q.output_rows, q.input_rows, q.input_bytes, q.retry_count,
-                 q.peak_memory_bytes, q.error)
+                 q.peak_memory_bytes, q.error, q.queued_ms, q.resource_group)
                 for q in runtime.queries()
             ]
             # dispatcher-tracked queries (control.py FSM) that predate or
@@ -130,8 +138,23 @@ class SystemConnector(Connector):
                 for info in dispatcher.queries():
                     if info.query_id not in seen:
                         out.append((info.query_id, info.state, "", info.sql,
-                                    0.0, 0.0, -1, 0, 0, 0, 0, None))
+                                    0.0, 0.0, -1, 0, 0, 0, 0, None, 0.0,
+                                    info.resource_group))
             return out
+        if table == "runtime.resource_groups":
+            runner = self._runner() if self._runner is not None else None
+            dispatcher = getattr(runner, "dispatcher", None)
+            if dispatcher is None:
+                return []
+            return [
+                (g.name, g.scheduling_policy, g.weight,
+                 g.soft_concurrency_limit
+                 if g.soft_concurrency_limit is not None
+                 else g.hard_concurrency_limit,
+                 g.hard_concurrency_limit, g.max_queued,
+                 g.running, g.queued, g.memory_usage_bytes, g.cpu_usage_s)
+                for g in dispatcher.groups()
+            ]
         if table == "runtime.tasks":
             return [
                 (t.query_id, t.task_id, t.fragment, t.task_index, t.worker,

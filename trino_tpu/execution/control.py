@@ -9,7 +9,10 @@ Mirrors the coordinator-side orchestration stack:
   → PLANNING → STARTING → RUNNING → FINISHING → FINISHED | FAILED
   (reference: execution/QueryState.java:26-58, QueryStateMachine.java)
 - :class:`ResourceGroup` — hierarchical admission control with concurrency +
-  queue quotas (reference: execution/resourcegroups/InternalResourceGroup.java:75)
+  queue quotas, weighted-fair scheduling, memory/CPU quotas; defined in
+  execution/resource_manager.py and re-exported here so the historical
+  import path keeps working
+  (reference: execution/resourcegroups/InternalResourceGroup.java:75)
 - :class:`DispatchManager` — accepts queries, runs them through group
   admission, tracks them (reference: dispatcher/DispatchManager.java:72,
   execution/QueryTracker.java:51)
@@ -132,96 +135,10 @@ class QueryInfo:
         return self.state_machine.state
 
 
-class ResourceGroup:
-    """Hierarchical admission: a query runs when every ancestor has a free
-    concurrency slot; otherwise it queues (FIFO) up to max_queued
-    (reference: InternalResourceGroup.java:75 — canRunMore/canQueueMore)."""
-
-    def __init__(self, name: str, hard_concurrency_limit: int = 100,
-                 max_queued: int = 1000,
-                 parent: Optional["ResourceGroup"] = None):
-        self.name = name
-        self.hard_concurrency_limit = hard_concurrency_limit
-        self.max_queued = max_queued
-        self.parent = parent
-        self.children: dict[str, ResourceGroup] = {}
-        self._running = 0
-        self._queue: list[threading.Event] = []
-        self._lock = parent._lock if parent is not None else threading.Lock()
-
-    def subgroup(self, name: str, **kwargs) -> "ResourceGroup":
-        with self._lock:  # _dispatch_queued iterates children under the lock
-            if name not in self.children:
-                self.children[name] = ResourceGroup(
-                    f"{self.name}.{name}", parent=self, **kwargs)
-            return self.children[name]
-
-    def _can_run(self) -> bool:
-        g: Optional[ResourceGroup] = self
-        while g is not None:
-            if g._running >= g.hard_concurrency_limit:
-                return False
-            g = g.parent
-        return True
-
-    def _acquire_now(self) -> None:
-        g: Optional[ResourceGroup] = self
-        while g is not None:
-            g._running += 1
-            g = g.parent
-
-    def acquire(self, timeout: float = 300.0) -> None:
-        """Block until admitted.  Raises RuntimeError when the queue is full
-        (QUERY_QUEUE_FULL in the reference)."""
-        with self._lock:
-            if self._can_run() and not self._queue:
-                self._acquire_now()
-                return
-            if len(self._queue) >= self.max_queued:
-                raise RuntimeError(
-                    f"resource group {self.name}: queue full "
-                    f"({self.max_queued})")
-            ticket = threading.Event()
-            self._queue.append(ticket)
-        if not ticket.wait(timeout):
-            with self._lock:
-                if ticket in self._queue:
-                    self._queue.remove(ticket)
-                    raise TimeoutError(
-                        f"resource group {self.name}: queued for {timeout}s")
-        # admitted by release()
-
-    def release(self) -> None:
-        with self._lock:
-            g: Optional[ResourceGroup] = self
-            while g is not None:
-                g._running -= 1
-                g = g.parent
-            self._dispatch_queued()
-
-    def _dispatch_queued(self) -> None:
-        # wake FIFO heads of every group that can now run (lock held)
-        def walk(g: ResourceGroup):
-            while g._queue and g._can_run():
-                g._acquire_now()
-                g._queue.pop(0).set()
-            for c in g.children.values():
-                walk(c)
-
-        root = self
-        while root.parent is not None:
-            root = root.parent
-        walk(root)
-
-    @property
-    def running(self) -> int:
-        with self._lock:
-            return self._running
-
-    @property
-    def queued(self) -> int:
-        with self._lock:
-            return len(self._queue)
+# the full weighted-fair/memory/CPU-quota group lives with the serving
+# plane; this module keeps the name so `from .control import ResourceGroup`
+# (tests, runners) stays the import path
+from .resource_manager import ResourceGroup  # noqa: E402
 
 
 class DispatchManager:
@@ -242,14 +159,28 @@ class DispatchManager:
         self._lock = threading.Lock()
 
     def _group_for(self, sql: str, session) -> ResourceGroup:
+        """Selector output is a dotted path under the root (``etl.heavy``);
+        path segments resolve against configured subgroups, creating
+        default-knob groups for unconfigured names."""
         if self._selector is None:
             return self.root
-        name = self._selector(sql, session)
-        return self.root.subgroup(name) if name else self.root
+        path = self._selector(sql, session)
+        g = self.root
+        for part in (path or "").split("."):
+            if part:
+                g = g.subgroup(part)
+        return g
 
     def submit(self, sql: str, session, run: Callable[[QueryStateMachine], object]):
         """Admission + lifecycle around ``run`` (the planning/execution
-        callback drives PLANNING..FINISHING itself via the FSM)."""
+        callback drives PLANNING..FINISHING itself via the FSM).  Queue
+        wait is recorded into the admission distribution + the query record
+        (system.runtime.queries queued_time_ms); the query's process-CPU
+        window is charged to the group at release so CPU quotas regenerate
+        against real usage."""
+        from ..telemetry import metrics as tm
+        from ..telemetry import runtime as rt
+
         with self._lock:
             qid = f"q_{next(self._ids)}"
         fsm = QueryStateMachine(qid)
@@ -261,12 +192,22 @@ class DispatchManager:
             while len(self._history) > self._max_history:
                 self._tracker.pop(self._history.pop(0), None)
         fsm.set("WAITING_FOR_RESOURCES")
+        t0 = time.monotonic()
         try:
-            group.acquire()
+            group.acquire(
+                timeout=getattr(session, "query_queued_timeout_s", 300.0),
+                priority=getattr(session, "query_priority", 0))
         except BaseException as e:
             fsm.fail(e)
             raise
+        queued_s = time.monotonic() - t0
+        tm.ADMISSION_QUEUED_SECONDS.record(queued_s)
+        rec = rt.current_record()
+        if rec is not None:
+            rec.queued_ms = queued_s * 1e3
+            rec.resource_group = group.name
         fsm.set("DISPATCHING")
+        cpu0 = time.process_time()
         try:
             result = run(fsm)
             fsm.finish()
@@ -275,7 +216,11 @@ class DispatchManager:
             fsm.fail(e)
             raise
         finally:
-            group.release()
+            group.release(cpu_s=time.process_time() - cpu0)
+
+    def groups(self) -> list[ResourceGroup]:
+        """The full group tree, preorder (system.runtime.resource_groups)."""
+        return self.root.walk()
 
     def query_info(self, query_id: str) -> Optional[QueryInfo]:
         with self._lock:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -50,11 +51,10 @@ class DistributedQueryRunner:
     def __init__(self, catalog: Optional[Catalog] = None,
                  worker_count: int = 3,
                  session: Optional[Session] = None):
-        from .control import (
-            DispatchManager,
-            HeartbeatFailureDetector,
-            NodeManager,
-            ResourceGroup,
+        from .control import HeartbeatFailureDetector, NodeManager
+        from .resource_manager import (
+            ClusterMemoryManager,
+            build_dispatch_manager,
         )
 
         self.catalog = catalog if catalog is not None else default_catalog()
@@ -70,10 +70,11 @@ class DistributedQueryRunner:
         self.failure_detector = HeartbeatFailureDetector(self.nodes)
         for i in range(worker_count):
             self.failure_detector.monitor(f"worker-{i}", lambda: True)
-        self.dispatcher = DispatchManager(ResourceGroup(
-            "global",
-            hard_concurrency_limit=self.session.query_concurrency,
-            max_queued=self.session.query_max_queued))
+        # admission: the TRINO_TPU_RESOURCE_GROUPS tree when configured,
+        # else the flat global group sized from the session knobs — plus the
+        # coordinator's cluster memory view + low-memory killer
+        self.dispatcher = build_dispatch_manager(self.session)
+        self.memory_manager = ClusterMemoryManager()
         import itertools
 
         from ..spi.eventlistener import EventListenerManager
@@ -269,6 +270,33 @@ class DistributedQueryRunner:
                        attempt: int = 0,
                        blacklist: frozenset = frozenset(),
                        use_fused: bool = True) -> QueryResult:
+        from ..telemetry import runtime as _rt
+        from .resource_manager import find_group
+
+        # register with the cluster memory manager: the handle carries the
+        # OOM-killer kill flag the scheduling/drain loops below poll, and
+        # every task's memory pool is booked under this query id
+        qrec = _rt.current_record()
+        mem_qid = qrec.query_id if qrec is not None else f"q@{id(subplan):x}"
+        max_mem = (self.session.query_max_memory_bytes
+                   or int(os.environ.get("TRINO_TPU_QUERY_MAX_MEMORY",
+                                         "0") or 0) or None)
+        handle = self.memory_manager.register_query(
+            mem_qid, priority=self.session.query_priority,
+            group=find_group(self.dispatcher.root,
+                             qrec.resource_group if qrec is not None else ""),
+            max_memory=max_mem)
+        try:
+            return self._run_streaming_inner(
+                subplan, stats_sink, attempt, blacklist, use_fused,
+                handle, mem_qid)
+        finally:
+            self.memory_manager.unregister_query(mem_qid)
+
+    def _run_streaming_inner(self, subplan: SubPlan,
+                             stats_sink: Optional[list], attempt: int,
+                             blacklist: frozenset, use_fused: bool,
+                             handle, mem_qid: str) -> QueryResult:
         from .collective_exchange import (
             CollectiveRepartitionExchange,
             collectives_available,
@@ -333,7 +361,7 @@ class DistributedQueryRunner:
         if self.session.task_scheduler == "TIME_SHARING":
             hung = self._run_time_sharing(
                 fragments, stages, errors, stats_sink, edges,
-                attempt)
+                attempt, handle=handle, memory_owner=mem_qid)
         else:
             from ..telemetry import runtime as _rt
 
@@ -348,7 +376,7 @@ class DistributedQueryRunner:
                     th = threading.Thread(
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
-                              edges, attempt, parent_span, qrec),
+                              edges, attempt, parent_span, qrec, mem_qid),
                         name=f"task-{f.id}.{t}",
                         daemon=True,
                     )
@@ -357,15 +385,34 @@ class DistributedQueryRunner:
                 th.start()
             from .task import STALL_TIMEOUT_S
 
-            for th in threads:
-                th.join(timeout=2 * STALL_TIMEOUT_S)
-            hung = [th.name for th in threads if th.is_alive()]
-        if errors or hung:
+            # polled join (not a plain join) so an OOM-killer verdict can
+            # unblock tasks parked on full/empty buffers mid-query
+            deadline = time.monotonic() + 2 * STALL_TIMEOUT_S
+            pending = list(threads)
+            aborted = False
+            while pending and time.monotonic() < deadline:
+                pending[0].join(timeout=0.1)
+                pending = [th for th in pending if th.is_alive()]
+                if not aborted and handle.poll() is not None:
+                    aborted = True
+                    for s in stages.values():
+                        for b in s.buffers:
+                            b.abort()
+                    for ex in edges.values():
+                        ex.abort()
+            hung = [th.name for th in pending if th.is_alive()]
+        kerr = handle.killed_error()
+        if errors or hung or kerr is not None:
             for s in stages.values():
                 for b in s.buffers:
                     b.abort()
             for ex in edges.values():
                 ex.abort()
+            if kerr is not None:
+                # the kill verdict wins over secondary task errors: aborted
+                # buffers make tasks fail with cascade exceptions that would
+                # otherwise mask the CLUSTER_OUT_OF_MEMORY cause
+                raise kerr
             if errors:
                 if use_fused and any(isinstance(e, FusedStageOverflow)
                                      for e in errors):
@@ -410,9 +457,14 @@ class DistributedQueryRunner:
         client = ExchangeClient(root.buffers, 0)
         batches = []
         while not client.is_finished():
+            handle.check()
             b = client.poll(timeout=0.2)
             if b is not None:
                 batches.append(maybe_deserialize(b))
+        # a kill that lands during FINISHING still fails the query: the
+        # victim must always observe its own kill or the killer's
+        # capacity projection (total -= victim bytes) goes stale
+        handle.check()
         return self._to_result(subplan, batches)
 
     def fte_run_attempt(self, fragment, task_index: int, task_count: int,
@@ -534,7 +586,9 @@ class DistributedQueryRunner:
                     stages: dict[int, "_Stage"],
                     stats_sink: Optional[list],
                     collective: dict,
-                    attempt: int = 0) -> tuple[list, Optional[QueryStats]]:
+                    attempt: int = 0,
+                    memory_owner: Optional[str] = None,
+                    ) -> tuple[list, Optional[QueryStats]]:
         f = stage.fragment
         # engine-level fault injection on the in-process streaming path,
         # keyed by (fragment, task, attempt) exactly like the FTE path —
@@ -567,6 +621,11 @@ class DistributedQueryRunner:
             hbm_limit_bytes=self.session.hbm_limit_bytes,
             task_concurrency=self.session.task_concurrency,
         )
+        if memory_owner is not None:
+            # book this task's HBM pool under the query id so the cluster
+            # memory manager sees in-process reservations too
+            self.memory_manager.register_pool(memory_owner,
+                                              planner.memory.pool)
         # swap the collector for the task's output sink; a fused producer
         # fragment plans only its FEED subtree — the Filter/Project chain,
         # the PARTIAL aggregation and the seam shuffle run inside the fused
@@ -596,7 +655,8 @@ class DistributedQueryRunner:
         return local.pipelines, stats
 
     def _run_time_sharing(self, fragments, stages, errors, stats_sink,
-                          collective, attempt: int = 0) -> list[str]:
+                          collective, attempt: int = 0, handle=None,
+                          memory_owner=None) -> list[str]:
         """Schedule every task on a bounded MLFQ executor
         (exec/executor.py); returns the names of tasks that never finished."""
         import time as _time
@@ -611,7 +671,8 @@ class DistributedQueryRunner:
                     stage = stages[f.id]
                     for t in range(stage.task_count):
                         pipelines, stats = self._build_task(
-                            stage, t, stages, stats_sink, collective, attempt)
+                            stage, t, stages, stats_sink, collective, attempt,
+                            memory_owner=memory_owner)
                         handles.append(
                             (f, t, executor.submit(pipelines, stats),
                              pipelines))
@@ -630,7 +691,18 @@ class DistributedQueryRunner:
 
             deadline = _time.monotonic() + 2 * STALL_TIMEOUT_S
             pending = list(range(len(handles)))
+            aborted = False
             while pending and _time.monotonic() < deadline:
+                if (not aborted and handle is not None
+                        and handle.poll() is not None):
+                    # OOM-killer verdict: unblock everything now; the caller
+                    # raises the CLUSTER_OUT_OF_MEMORY error
+                    aborted = True
+                    for s in stages.values():
+                        for b in s.buffers:
+                            b.abort()
+                    for ex in collective.values():
+                        ex.abort()
                 still = []
                 for i in pending:
                     f, t, h, pipelines = handles[i]
@@ -669,7 +741,7 @@ class DistributedQueryRunner:
                   stats_sink: Optional[list] = None,
                   collective: Optional[dict] = None,
                   attempt: int = 0, parent_span=None,
-                  query_record=None) -> None:
+                  query_record=None, memory_owner=None) -> None:
         import time as _time
 
         from ..exec.driver import collect_scan_stats
@@ -693,7 +765,7 @@ class DistributedQueryRunner:
             try:
                 pipelines, stats = self._build_task(
                     stage, task_index, stages, stats_sink, collective or {},
-                    attempt)
+                    attempt, memory_owner=memory_owner)
                 run_pipelines(pipelines, stats)
             except BaseException as e:  # noqa: BLE001 — surfaced to
                 # coordinator

@@ -514,6 +514,10 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                     f"{self.failure_detector.last_error(wurl)}",
                     remote_host=wurl)
             status = self.failure_detector.last_status(wurl) or {}
+            # the same cached status JSON feeds the cluster memory view:
+            # per-task query_id + memory_reserved_bytes aggregate on the
+            # coordinator (ClusterMemoryManager.update_worker)
+            self.memory_manager.update_worker(wurl, status)
             task_states = status.get("tasks", {})
             for fid, t, task_id in owned:
                 st = task_states.get(task_id)
@@ -559,10 +563,23 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
 
     def _run_remote(self, subplan: SubPlan, attempt: int = 0,
                     blacklist: frozenset = frozenset()) -> QueryResult:
+        from ..telemetry import runtime as _rtl
+        from .resource_manager import find_group
         from .tracing import traceparent as _traceparent
 
         self._query_seq += 1
         qid = f"pq{self._query_seq}"
+        # cluster memory accounting is keyed by the WORKER-visible query id
+        # (worker status payloads carry it per task), so register under qid
+        qrec = _rtl.current_record()
+        max_mem = (self.session.query_max_memory_bytes
+                   or int(os.environ.get("TRINO_TPU_QUERY_MAX_MEMORY",
+                                         "0") or 0) or None)
+        handle = self.memory_manager.register_query(
+            qid, priority=self.session.query_priority,
+            group=find_group(self.dispatcher.root,
+                             qrec.resource_group if qrec is not None else ""),
+            max_memory=max_mem)
         # the open trino.query span (run_with_query_events) becomes the
         # remote parent of every worker task span for this attempt
         parent_span = self.tracer.current()
@@ -651,6 +668,10 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                 if now - last_status > self.session.heartbeat_interval_s:
                     last_status = now
                     self._check_workers(by_worker)
+                    # worker snapshots just refreshed: give the low-memory
+                    # killer a chance, then surface a verdict against US
+                    handle.poll()
+                handle.check()
                 if now > deadline:
                     raise TimeoutError("remote query stalled")
             self._collect_task_spans(tasks, parent_span)
@@ -660,6 +681,7 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                 rt.cancel()
             raise
         finally:
+            self.memory_manager.unregister_query(qid)
             if client is not None:
                 self.resilience.exchange_fetch_failures += \
                     client.stats["fetch_failures"]

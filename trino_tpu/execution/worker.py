@@ -72,10 +72,21 @@ class _Task:
         self.span: Optional[dict] = None
         self.ready = threading.Event()
         self.thread: Optional[threading.Thread] = None
+        # cluster memory feed: the owning query + the task's live HBM pool
+        # (exec/revoking.TaskMemoryContext), reported per status sweep so
+        # the coordinator's ClusterMemoryManager can aggregate reservations
+        self.query_id: Optional[str] = None
+        self.memory = None
 
     def status_json(self, include_span: bool = False) -> dict:
+        mem = self.memory
+        reserved = 0
+        if mem is not None:
+            reserved = int(mem.pool.reserved + mem.pool.reserved_revocable)
         out = {"state": self.state, "error": self.error,
-               "error_type": self.error_type, "error_code": self.error_code}
+               "error_type": self.error_type, "error_code": self.error_code,
+               "query_id": self.query_id,
+               "memory_reserved_bytes": reserved}
         if include_span and self.span is not None:
             out["span"] = self.span
         return out
@@ -361,6 +372,7 @@ class TaskServer:
             catalog = build_catalog(desc["catalog"])
             fragment = desc["fragment"]
             task_index = desc["task_index"]
+            t.query_id = desc.get("query_id")
             # streaming descriptors carry the query-retry attempt at the top
             # level; FTE descriptors keep it inside the spool block
             attempt = desc.get(
@@ -431,6 +443,7 @@ class TaskServer:
                 dynamic_filtering=desc.get("dynamic_filtering", True),
                 hbm_limit_bytes=desc.get("hbm_limit_bytes", 16 << 30),
             )
+            t.memory = planner.memory
             local = planner.plan(fragment.root)
             if "spool" in desc:  # FTE: durable on-disk attempt spool
                 spool = desc["spool"]

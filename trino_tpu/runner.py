@@ -425,6 +425,16 @@ class Session:
     # reference: execution/resourcegroups/InternalResourceGroup.java:75)
     query_concurrency: int = 16
     query_max_queued: int = 200
+    # multi-tenant serving (execution/resource_manager.py): the selector
+    # workload tag (maps to a resource group via TRINO_TPU_RESOURCE_GROUPS
+    # selectors), the ticket priority under scheduling_policy=query_priority
+    # and the OOM-killer victim ordering, the admission-queue wait budget,
+    # and the per-query reservation cap (0 = TRINO_TPU_QUERY_MAX_MEMORY env
+    # or unlimited)
+    source: str = ""
+    query_priority: int = 0
+    query_queued_timeout_s: float = 300.0
+    query_max_memory_bytes: int = 0
     # active transaction (execution/transaction.py); None = autocommit
     transaction: object = None
     _transaction_manager: object = None

@@ -83,6 +83,17 @@ class QueryDispatcher:
             q.state = "CANCELED"
             q.done.set()
             return
+        try:
+            self._await_memory(q)
+        except Exception as e:
+            q.error = f"{type(e).__name__}: {e}"
+            q.state = "FAILED"
+            q.done.set()
+            return
+        if q.cancelled:
+            q.state = "CANCELED"
+            q.done.set()
+            return
         q.state = "RUNNING"
         try:
             result = self.runner.execute(q.sql)
@@ -102,6 +113,45 @@ class QueryDispatcher:
             q.error = f"{type(e).__name__}: {e}"
             q.state = "FAILED"
         q.done.set()
+
+    def _await_memory(self, q: _Query) -> None:
+        """Memory-aware admission: estimate the query's peak from the
+        query-record history of the same plan fingerprint (telemetry
+        runtime.fingerprint) and hold it QUEUED while the cluster lacks
+        headroom — admitting into certain OOM just feeds the killer.
+        Raises QUERY_QUEUED_TIMEOUT (USER, never retried) when the wait
+        budget runs out.  No-op when the runner has no memory manager or
+        the cluster is uncapped."""
+        mm = getattr(self.runner, "memory_manager", None)
+        if mm is None or mm.capacity_bytes is None:
+            return
+        import os
+        import time
+
+        from ..execution.resource_manager import estimate_peak_memory
+        from ..spi.errors import QUERY_QUEUED_TIMEOUT, TrinoError
+        from ..telemetry import metrics as tm
+        from ..telemetry.runtime import fingerprint
+
+        default = int(os.environ.get("TRINO_TPU_QUERY_DEFAULT_MEMORY",
+                                     str(64 << 20)))
+        est = estimate_peak_memory(fingerprint(q.sql), default)
+        budget = getattr(getattr(self.runner, "session", None),
+                         "query_queued_timeout_s", 300.0)
+        t0 = time.monotonic()
+        while not mm.can_admit(est):
+            if q.cancelled:
+                return
+            if time.monotonic() - t0 > budget:
+                raise TrinoError(
+                    QUERY_QUEUED_TIMEOUT,
+                    f"queued {budget:.0f}s waiting for {est} bytes of "
+                    f"cluster memory (free: {mm.cluster_free_bytes()})")
+            mm.maybe_enforce()
+            time.sleep(0.05)
+        waited = time.monotonic() - t0
+        if waited > 0.05:
+            tm.ADMISSION_QUEUED_SECONDS.record(waited)
 
     def get(self, qid: str) -> Optional[_Query]:
         with self._lock:

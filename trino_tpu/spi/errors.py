@@ -36,6 +36,8 @@ __all__ = [
     "GENERIC_USER_ERROR", "GENERIC_INTERNAL_ERROR", "REMOTE_TASK_ERROR",
     "REMOTE_HOST_GONE", "PAGE_TRANSPORT_TIMEOUT", "PAGE_TRANSPORT_ERROR",
     "EXCEEDED_MEMORY_LIMIT_CODE", "NO_NODES_AVAILABLE",
+    "QUERY_QUEUE_FULL", "QUERY_QUEUED_TIMEOUT", "CLUSTER_OUT_OF_MEMORY",
+    "EXCEEDED_GLOBAL_MEMORY_LIMIT",
     "classify", "is_retryable_type", "lookup_code",
 ]
 
@@ -72,11 +74,25 @@ class ErrorCode:
 GENERIC_USER_ERROR = ErrorCode("GENERIC_USER_ERROR", 0x0000, USER)
 SYNTAX_ERROR = ErrorCode("SYNTAX_ERROR", 0x0001, USER)
 DIVISION_BY_ZERO = ErrorCode("DIVISION_BY_ZERO", 0x0008, USER)
+# admission rejections are USER on purpose: re-submitting an identical query
+# into the same full queue re-fails identically, so the retry_policy=QUERY
+# loop must never burn attempts on them (reference: StandardErrorCode
+# QUERY_QUEUE_FULL / EXCEEDED_TIME_LIMIT family)
+QUERY_QUEUE_FULL = ErrorCode("QUERY_QUEUE_FULL", 0x0009, USER)
+QUERY_QUEUED_TIMEOUT = ErrorCode("QUERY_QUEUED_TIMEOUT", 0x000A, USER)
 GENERIC_INTERNAL_ERROR = ErrorCode("GENERIC_INTERNAL_ERROR", 0x1_0000, INTERNAL)
 EXCEEDED_MEMORY_LIMIT_CODE = ErrorCode(
     "EXCEEDED_LOCAL_MEMORY_LIMIT", 0x2_0000, INSUFFICIENT_RESOURCES)
 NO_NODES_AVAILABLE = ErrorCode(
     "NO_NODES_AVAILABLE", 0x2_0001, INSUFFICIENT_RESOURCES)
+# OOM-killer victims: INSUFFICIENT_RESOURCES, so an INTERNAL workload killed
+# to relieve cluster pressure is eligible for a retry_policy=QUERY re-run
+# once the pressure clears (reference: ClusterMemoryManager.java:90 +
+# LowMemoryKiller)
+CLUSTER_OUT_OF_MEMORY = ErrorCode(
+    "CLUSTER_OUT_OF_MEMORY", 0x2_0002, INSUFFICIENT_RESOURCES)
+EXCEEDED_GLOBAL_MEMORY_LIMIT = ErrorCode(
+    "EXCEEDED_GLOBAL_MEMORY_LIMIT", 0x2_0003, INSUFFICIENT_RESOURCES)
 REMOTE_TASK_ERROR = ErrorCode("REMOTE_TASK_ERROR", 0x3_0000, EXTERNAL)
 PAGE_TRANSPORT_ERROR = ErrorCode("PAGE_TRANSPORT_ERROR", 0x3_0001, EXTERNAL)
 PAGE_TRANSPORT_TIMEOUT = ErrorCode(
@@ -85,7 +101,9 @@ REMOTE_HOST_GONE = ErrorCode("REMOTE_HOST_GONE", 0x3_0003, EXTERNAL)
 
 _CODES = {c.name: c for c in (
     GENERIC_USER_ERROR, SYNTAX_ERROR, DIVISION_BY_ZERO,
+    QUERY_QUEUE_FULL, QUERY_QUEUED_TIMEOUT,
     GENERIC_INTERNAL_ERROR, EXCEEDED_MEMORY_LIMIT_CODE, NO_NODES_AVAILABLE,
+    CLUSTER_OUT_OF_MEMORY, EXCEEDED_GLOBAL_MEMORY_LIMIT,
     REMOTE_TASK_ERROR, PAGE_TRANSPORT_ERROR, PAGE_TRANSPORT_TIMEOUT,
     REMOTE_HOST_GONE,
 )}

@@ -11,7 +11,9 @@ Semantics kept from the reference:
 - *revocable* memory is tracked separately and can be reclaimed by asking the
   owning operator to spill (see exec/revoking.py);
 - exceeding the pool limit raises :class:`ExceededMemoryLimitError`
-  (the per-node OOM; cluster-level killer is a later round).
+  (the per-node OOM); the CLUSTER-level view — aggregation of these pools
+  across queries/workers plus the low-memory killer — lives in
+  execution/resource_manager.py ClusterMemoryManager.
 """
 
 from __future__ import annotations

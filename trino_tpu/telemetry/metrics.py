@@ -444,8 +444,41 @@ DEVICE_MEMORY_PEAK = REGISTRY.gauge(
     "trino_device_memory_peak_bytes",
     "allocator peak bytes in use, all devices")
 
+# multi-tenant serving plane (execution/resource_manager.py): admission
+# wait, the low-memory killer, and the coordinator's cluster memory view
+ADMISSION_QUEUED_SECONDS = REGISTRY.distribution(
+    "trino_admission_queued_seconds",
+    "time queries wait for admission (group slot or cluster memory)")
+OOM_KILLS = REGISTRY.counter(
+    "trino_oom_kills_total",
+    "queries killed by the cluster low-memory killer")
+CLUSTER_MEMORY_RESERVED = REGISTRY.gauge(
+    "trino_cluster_memory_reserved_bytes",
+    "bytes reserved across all tracked query memory pools")
+CLUSTER_MEMORY_FREE = REGISTRY.gauge(
+    "trino_cluster_memory_free_bytes",
+    "cluster memory capacity minus reservations (0 when uncapped)")
+
 
 # ------------------------------------------------------------ observe hooks
+def resource_group_gauges(path: str):
+    """(running, queued) gauge pair for one resource group.  Group trees
+    are operator config, so these names are the one sanctioned DYNAMIC
+    registration: ``trino_resource_group_{running,queued}_<path>`` with the
+    dotted path mangled to a Prometheus-legal suffix.  MetricsRegistry
+    get-or-create semantics make repeated calls cheap and idempotent."""
+    import re as _re
+
+    suffix = _re.sub(r"[^a-zA-Z0-9_]", "_", path)
+    prefix = "trino_resource_group_"
+    return (
+        REGISTRY.gauge(prefix + "running_" + suffix,
+                       f"queries running in resource group {path}"),
+        REGISTRY.gauge(prefix + "queued_" + suffix,
+                       f"queries queued in resource group {path}"),
+    )
+
+
 def observe_scan(ingest) -> None:
     """Fold a ScanIngestStats roll-up (exec/stats.py) into the registry."""
     if ingest is None or not ingest.scan_batches:

@@ -15,6 +15,7 @@ dogfooding its own scan path.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from collections import deque
@@ -23,15 +24,24 @@ from typing import Optional
 __all__ = [
     "QueryRecord", "TaskRecord", "query_started", "query_finished",
     "current_record", "add_input", "add_retries", "task_started",
-    "task_finished", "queries", "tasks",
+    "task_finished", "queries", "tasks", "fingerprint",
 ]
+
+
+def fingerprint(sql: str) -> str:
+    """Whitespace/case-normalized SQL hash: the plan-fingerprint key the
+    memory-aware admission path uses to find prior runs of the same
+    statement (execution/resource_manager.py estimate_peak_memory)."""
+    norm = " ".join(sql.strip().lower().split())
+    return hashlib.sha1(norm.encode("utf-8")).hexdigest()[:16]
 
 
 class QueryRecord:
     __slots__ = ("query_id", "sql", "user", "state", "create_time",
                  "end_time", "wall_ms", "cpu_ms", "output_rows", "error",
                  "input_rows", "input_bytes", "retry_count",
-                 "peak_memory_bytes", "_lock")
+                 "peak_memory_bytes", "fingerprint", "queued_ms",
+                 "resource_group", "_lock")
 
     def __init__(self, query_id: str, sql: str, user: str):
         self.query_id = query_id
@@ -48,6 +58,9 @@ class QueryRecord:
         self.input_bytes = 0
         self.retry_count = 0
         self.peak_memory_bytes = 0
+        self.fingerprint = fingerprint(sql)
+        self.queued_ms = 0.0
+        self.resource_group = ""
         self._lock = threading.Lock()
 
 

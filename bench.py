@@ -402,6 +402,162 @@ def run_qps_bench(duration_s: float = None, sf: float = None,
     return result
 
 
+def _chaos_spec_drill() -> dict:
+    """Speculation tail-cut acceptance: one injected TASK_STALL straggler
+    on a leaf stage; TRINO_TPU_SPECULATION=1 must cut the wall to <=0.5x
+    of the no-speculation run with identical rows and the loser provably
+    cancelled (first-commit-wins — the row sets match exactly, so no
+    double-commit)."""
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.execution.failure_injector import (
+        TASK_STALL,
+        FailureInjector,
+    )
+    from trino_tpu.runner import Session
+
+    sql = ("select l_returnflag, count(*), sum(l_quantity) from lineitem "
+           "group by l_returnflag order by l_returnflag")
+    prev = os.environ.get("TRINO_TPU_FUSED_STAGE")
+    os.environ["TRINO_TPU_FUSED_STAGE"] = "0"  # leaf-only eligibility
+    try:
+        def once(spec: bool):
+            inj = FailureInjector()
+            # collectives off: a twin cannot join an in-flight all_to_all,
+            # so collective-edge leaves are speculation-ineligible and the
+            # drill would never speculate on a multi-device mesh
+            r = DistributedQueryRunner(
+                default_catalog(scale_factor=0.01), worker_count=4,
+                session=Session(node_count=4, failure_injector=inj,
+                                speculation=spec, use_collectives=False))
+            leaf = [f for f in r.create_subplan(sql).all_fragments()
+                    if not f.source_fragments][0]
+            inj.inject(TASK_STALL, fragment_id=leaf.id, task_index=0,
+                       attempt=0, stall_s=3.0)
+            t0 = time.perf_counter()
+            rows = r.execute(sql).rows()
+            return time.perf_counter() - t0, rows, r
+
+        wall_off, rows_off, _ = once(False)
+        wall_on, rows_on, r = once(True)
+    finally:
+        if prev is None:
+            os.environ.pop("TRINO_TPU_FUSED_STAGE", None)
+        else:
+            os.environ["TRINO_TPU_FUSED_STAGE"] = prev
+    return {
+        "wall_s_no_speculation": round(wall_off, 3),
+        "wall_s_speculation": round(wall_on, 3),
+        "ratio": round(wall_on / wall_off, 3),
+        "rows_identical": sorted(rows_off) == sorted(rows_on),
+        "speculative_starts": r.speculative_starts,
+        "speculative_wins": r.speculative_wins,
+        "pass": (wall_on <= 0.5 * wall_off
+                 and sorted(rows_off) == sorted(rows_on)
+                 and r.speculative_wins >= 1),
+    }
+
+
+def _chaos_rolling_restart_drill() -> dict:
+    """Rolling-restart acceptance: drain every worker one at a time (real
+    PUT /v1/shutdown + replacement) under sustained query load — zero
+    queries lost."""
+    import threading
+
+    from trino_tpu.execution.remote import ProcessDistributedQueryRunner
+    from trino_tpu.runner import Session
+    from trino_tpu.testing.chaos import CATALOG_SPEC, _ENV, QUERY_MIX
+
+    r = ProcessDistributedQueryRunner(
+        CATALOG_SPEC, worker_count=2,
+        session=Session(node_count=2, retry_policy="QUERY",
+                        retry_initial_delay_s=0.01,
+                        heartbeat_interval_s=0.2, drain_timeout_s=10.0),
+        env_overrides=_ENV)
+    stop = threading.Event()
+    ok, failed = [], []
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            sql = QUERY_MIX[i % len(QUERY_MIX)]
+            i += 1
+            try:
+                r.execute(sql).rows()
+                ok.append(sql)
+            except Exception as e:  # noqa: BLE001 - any loss is a failure
+                failed.append(f"{type(e).__name__}: {e}")
+
+    try:
+        r.execute(QUERY_MIX[0]).rows()  # warm up before the restarts
+        th = threading.Thread(target=load, daemon=True)
+        th.start()
+        summaries = r.rolling_restart()
+        time.sleep(1.0)
+        stop.set()
+        th.join(60)
+        states = r.execute(
+            "select worker, state from system.runtime.workers").rows()
+    finally:
+        r.close()
+    return {
+        "workers_drained": len(summaries),
+        "escalated": sum(1 for s in summaries if s["escalated"]),
+        "queries_completed": len(ok),
+        "queries_lost": len(failed),
+        "failures": failed[:5],
+        "final_worker_states": sorted(states),
+        "pass": (len(failed) == 0 and len(ok) > 0
+                 and sum(1 for _, st in states if st == "ACTIVE") == 2),
+    }
+
+
+def run_chaos_bench(write: bool = True) -> dict:
+    """``bench.py --chaos``: the chaos-certification soak.  A seeded
+    randomized fault-injection campaign (trino_tpu/testing/chaos.py) over
+    in-process and real-process clusters, plus the two acceptance drills
+    (speculation tail-cut, rolling restart).  Writes BENCH_r09.json."""
+    n = int(os.environ.get("BENCH_CHAOS_SCENARIOS", "25"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1009"))
+    _ensure_backend()
+    _enable_compile_cache()
+
+    from trino_tpu.telemetry.metrics import REGISTRY
+    from trino_tpu.testing.chaos import run_chaos
+
+    print(f"chaos soak: {n} scenarios from seed {seed}", file=sys.stderr)
+    t0 = time.perf_counter()
+    soak = run_chaos(n_scenarios=n, base_seed=seed)
+    soak_wall = time.perf_counter() - t0
+    print("speculation tail-cut drill", file=sys.stderr)
+    spec = _chaos_spec_drill()
+    print("rolling-restart drill", file=sys.stderr)
+    rolling = _chaos_rolling_restart_drill()
+
+    accounted = (soak["n_queries"] - soak["hangs"] - soak["unexpected"]
+                 ) / max(soak["n_queries"], 1)
+    result = {
+        "metric": f"chaos_soak_{n}_scenarios_accounted_fraction",
+        "value": round(accounted, 4),
+        "unit": "fraction of queries oracle-correct or correctly classified"
+                " (target 1.0, zero hangs)",
+        "soak_wall_s": round(soak_wall, 1),
+        "soak": soak,
+        "speculation_drill": spec,
+        "rolling_restart_drill": rolling,
+        "metrics": {k: v for k, v in REGISTRY.snapshot().items()
+                    if k.startswith(("trino_speculative", "trino_drains",
+                                     "trino_blacklisted"))},
+    }
+    print(json.dumps({k: v for k, v in result.items() if k != "soak"}))
+    if write:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r09.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
 def run_baseline() -> None:
     """CPU reference: same engine, same data, 8-worker DistributedQueryRunner.
     Runs in a subprocess with JAX_PLATFORMS=cpu (BASELINE.md config #1)."""
@@ -911,6 +1067,9 @@ def main() -> None:
         return
     if "--qps" in sys.argv:
         run_qps_bench()
+        return
+    if "--chaos" in sys.argv:
+        run_chaos_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

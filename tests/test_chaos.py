@@ -1,0 +1,367 @@
+"""Chaos-certified streaming resilience (ISSUE 9).
+
+Deterministic drills over the straggler-speculation + graceful-drain +
+chaos-soak machinery:
+
+- ClusterBlacklist unit behavior (TTL expiry, threshold scoring) under a
+  fake clock;
+- TaskGate first-commit-wins semantics (the loser's writes raise
+  SpeculationLost; no double-commit is possible by construction);
+- speculation tail-cut: one injected TASK_STALL straggler, speculation
+  on cuts the wall to <=0.5x with identical rows and a cancelled loser;
+- mid-query drain with TRINO_TPU_FUSED_STAGE=1: the device-resident
+  subplan re-runs cleanly on the replacement worker;
+- rolling restart under load loses zero queries and
+  system.runtime.workers reflects the state transitions;
+- a fast fixed-seed chaos smoke (tier-1) and the full 25-scenario soak
+  (marked slow; bench.py --chaos records it in BENCH_r09.json).
+"""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.execution.exchange import OutputBuffer
+from trino_tpu.execution.failure_injector import (
+    PROCESS_EXIT,
+    TASK_STALL,
+    FailureInjector,
+    sleep_with_cancel,
+)
+from trino_tpu.execution.speculation import (
+    SPECULATIVE,
+    STANDARD,
+    ClusterBlacklist,
+    GatedBuffer,
+    SpeculationLost,
+    StreamingSpeculation,
+    TaskGate,
+    drain_timeout_s,
+)
+from trino_tpu.runner import Session
+from trino_tpu.testing.chaos import build_expected, run_scenario
+
+CATALOG_SPEC = {
+    "factory": "trino_tpu.connectors.catalog:default_catalog",
+    "kwargs": {"scale_factor": 0.01},
+}
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+# --------------------------------------------------------------- unit layer
+def test_cluster_blacklist_scoring_and_ttl():
+    now = [0.0]
+    bl = ClusterBlacklist(ttl_s=10.0, threshold=2.0, clock=lambda: now[0])
+    assert not bl.is_blacklisted("w1")
+    assert bl.record_failure("w1", reason="REMOTE_HOST_GONE") == 1.0
+    assert not bl.is_blacklisted("w1")  # below threshold
+    bl.record_failure("w1", reason="REMOTE_TASK_ERROR")
+    assert bl.is_blacklisted("w1")
+    assert bl.blacklisted() == frozenset({"w1"})
+    assert bl.snapshot() == {"w1": 2.0}
+    now[0] = 10.5  # both entries expired
+    assert not bl.is_blacklisted("w1")
+    assert bl.score("w1") == 0.0
+    # expiry is per-entry, not per-worker
+    bl.record_failure("w2")
+    now[0] = 15.0
+    bl.record_failure("w2")
+    now[0] = 20.6  # first w2 entry expired, second still live
+    assert bl.score("w2") == 1.0
+
+
+def test_task_gate_first_commit_wins():
+    claims = []
+    gate = TaskGate(on_claim=lambda k: claims.append(k),
+                    on_finish=lambda k: None)
+    assert gate.claim(SPECULATIVE)  # first claimer owns the stream
+    assert gate.claim(SPECULATIVE)  # idempotent for the owner
+    assert not gate.claim(STANDARD)
+    assert gate.owner == SPECULATIVE
+    assert claims == [SPECULATIVE]
+
+
+def test_gated_buffer_loser_raises_not_commits():
+    from trino_tpu.spi.batch import Column, ColumnBatch
+    from trino_tpu.spi.types import BIGINT
+
+    inner = OutputBuffer(1)
+    gate = TaskGate(on_claim=lambda k: None, on_finish=lambda k: None)
+    win = GatedBuffer(inner, gate, STANDARD)
+    lose = GatedBuffer(inner, gate, SPECULATIVE)
+    batch = ColumnBatch(["x"], [Column.from_values(BIGINT, [1, 2])])
+    win.enqueue(0, batch)
+    with pytest.raises(SpeculationLost):
+        lose.enqueue(0, batch)
+    with pytest.raises(SpeculationLost):
+        lose.set_finished()
+    win.set_finished()
+    # exactly the winner's page committed; finished but not yet acked
+    assert inner.pages_enqueued == 1
+    assert not inner.drained
+    assert gate.finished
+
+
+def test_speculation_twin_spawns_only_past_cutoff():
+    now = [0.0]
+    events = []
+    spec = StreamingSpeculation(lag_multiplier=2.0, min_delay_s=0.1,
+                                events=events, clock=lambda: now[0])
+    spec.register_stage(7, 3)
+    gates = [spec.register_task(7, t) for t in range(3)]
+    spawned = []
+
+    def spawn(fid, t):
+        spawned.append((fid, t))
+        return threading.Thread(target=lambda: None)
+
+    assert spec.tick(spawn) == [] and spawned == []  # no medians yet
+    now[0] = 0.2
+    gates[0].claim(STANDARD)
+    gates[0].finish(STANDARD)
+    gates[1].claim(STANDARD)
+    gates[1].finish(STANDARD)
+    # committed 2/3, median 0.2 -> cutoff 0.4; not lagging yet
+    assert spec.tick(spawn) == []
+    now[0] = 0.5
+    threads = spec.tick(spawn)
+    assert spawned == [(7, 2)] and len(threads) == 1
+    assert spec.tick(spawn) == []  # one twin per task, ever
+    assert spec.starts == 1
+    assert ("speculative_start", 7, 2) in events
+
+
+def test_sleep_with_cancel_returns_early():
+    flag = threading.Event()
+    t = threading.Timer(0.1, flag.set)
+    t.start()
+    t0 = time.monotonic()
+    assert sleep_with_cancel(5.0, flag.is_set) is True
+    assert time.monotonic() - t0 < 2.0
+    assert sleep_with_cancel(0.05, lambda: False) is False
+
+
+def test_drain_timeout_knob_resolution(monkeypatch):
+    monkeypatch.delenv("TRINO_TPU_DRAIN_TIMEOUT_S", raising=False)
+    assert drain_timeout_s(None, 30.0) == 30.0
+    monkeypatch.setenv("TRINO_TPU_DRAIN_TIMEOUT_S", "7.5")
+    assert drain_timeout_s(None, 30.0) == 7.5
+    assert drain_timeout_s(Session(drain_timeout_s=3.0), 30.0) == 3.0
+
+
+# ------------------------------------------------- speculation (in-process)
+def test_speculation_tail_cut_and_loser_cancelled(monkeypatch):
+    """THE tail-cut acceptance drill: an injected TASK_STALL straggler on a
+    leaf stage; speculation on must finish in <=0.5x the no-speculation
+    wall with EXACTLY the same rows (first-commit-wins: a double-commit
+    would double the counts) and the loser cancelled in the event log."""
+    monkeypatch.setenv("TRINO_TPU_FUSED_STAGE", "0")  # leaf eligibility
+    sql = ("select l_returnflag, count(*), sum(l_quantity) from lineitem "
+           "group by l_returnflag order by l_returnflag")
+
+    def once(spec):
+        inj = FailureInjector()
+        # collectives off: a speculative twin cannot join an in-flight
+        # all_to_all (every mesh participant must show up), so collective-
+        # edge leaves are ineligible by design — on the 8-virtual-device
+        # test mesh the leaf REPARTITION edge would otherwise go
+        # collective and the drill would never speculate.  lag_multiplier
+        # tuned down so the cutoff clears the straggler stall.
+        r = DistributedQueryRunner(
+            default_catalog(scale_factor=0.01), worker_count=4,
+            session=Session(node_count=4, failure_injector=inj,
+                            speculation=spec, use_collectives=False,
+                            speculation_lag_multiplier=1.2,
+                            speculation_min_delay_s=0.25))
+        leaf = [f for f in r.create_subplan(sql).all_fragments()
+                if not f.source_fragments][0]
+        inj.inject(TASK_STALL, fragment_id=leaf.id, task_index=0,
+                   attempt=0, stall_s=8.0)
+        t0 = time.perf_counter()
+        rows = r.execute(sql).rows()
+        return time.perf_counter() - t0, rows, r
+
+    wall_off, rows_off, _ = once(False)
+    wall_on, rows_on, r = once(True)
+    assert wall_on <= 0.5 * wall_off, (wall_on, wall_off)
+    assert rows_on == rows_off  # exact: no double-commit, order included
+    assert r.speculative_starts >= 1 and r.speculative_wins >= 1
+    kinds = [e[0] for e in r.resilience_events]
+    assert "speculative_start" in kinds and "speculative_win" in kinds
+    assert "speculative_cancelled" in kinds  # the loser was cancelled
+
+
+def test_speculation_off_by_default():
+    r = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2))
+    assert r.execute("select count(*) from nation").rows() == [(25,)]
+    assert r.speculative_starts == 0
+
+
+# ------------------------------------------------------ drain (in-process)
+def test_inproc_drain_and_workers_table():
+    r = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2))
+    sql = "select worker, state from system.runtime.workers order by worker"
+    assert [s for _, s in r.execute(sql).rows()] == ["ACTIVE", "ACTIVE"]
+    r.drain_worker("worker-1")
+    assert dict(r.execute(sql).rows())["worker-1"] == "SHUTTING_DOWN"
+    # draining stops NEW placement but running queries still complete
+    assert r.execute("select count(*) from orders").rows() == [(15000,)]
+    r.restore_worker("worker-1")
+    assert [s for _, s in r.execute(sql).rows()] == ["ACTIVE", "ACTIVE"]
+    kinds = [e for e in r.resilience_events if e[0] == "drain"]
+    assert [e[2] for e in kinds] == ["started", "drained", "restored"]
+
+
+# ----------------------------------------------------- process-level drills
+@pytest.mark.slow
+def test_fused_stage_drain_rerun_on_replacement(monkeypatch):
+    """Mid-query drain with whole-stage compilation ON: the device-resident
+    subplan's worker is drained away mid-flight; the query re-runs cleanly
+    on the replacement worker with oracle-correct rows."""
+    from trino_tpu.execution.remote import ProcessDistributedQueryRunner
+
+    env = dict(_ENV, TRINO_TPU_FUSED_STAGE="1")
+    monkeypatch.setenv("TRINO_TPU_FUSED_STAGE", "1")
+    sql = ("select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+           "from lineitem group by l_returnflag, l_linestatus "
+           "order by l_returnflag, l_linestatus")
+    expected = build_expected()[sql]
+    r = ProcessDistributedQueryRunner(
+        CATALOG_SPEC, worker_count=2,
+        session=Session(node_count=2, retry_policy="QUERY",
+                        retry_initial_delay_s=0.01,
+                        heartbeat_interval_s=0.2, drain_timeout_s=5.0),
+        env_overrides=env)
+    try:
+        holder = {}
+
+        def work():
+            try:
+                holder["rows"] = r.execute(sql).rows()
+            except BaseException as e:  # noqa: BLE001
+                holder["exc"] = e
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        summary = r.drain_worker(r.workers[0], replace=True)
+        th.join(90)
+        assert not th.is_alive(), "query hung across the drain"
+        assert "exc" not in holder, holder.get("exc")
+        from trino_tpu.testing.oracle import assert_same_rows
+        assert_same_rows(holder["rows"], expected, ordered=False)
+        assert summary["replacement"] is not None
+        drains = [e for e in r.resilience_events if e[0] == "drain"]
+        assert [e[2] for e in drains][0] == "started"
+        assert "replaced" in [e[2] for e in drains]
+    finally:
+        r.close()
+
+
+@pytest.mark.slow
+def test_rolling_restart_loses_zero_queries():
+    """Drain every worker one at a time (real shutdown + replacement)
+    under sustained load: zero queries lost, and system.runtime.workers
+    reflects the transitions (everything ACTIVE again at the end)."""
+    from trino_tpu.execution.remote import ProcessDistributedQueryRunner
+
+    r = ProcessDistributedQueryRunner(
+        CATALOG_SPEC, worker_count=2,
+        session=Session(node_count=2, retry_policy="QUERY",
+                        retry_initial_delay_s=0.01,
+                        heartbeat_interval_s=0.2, drain_timeout_s=10.0),
+        env_overrides=_ENV)
+    stop = threading.Event()
+    ok, failed = [], []
+
+    def load():
+        while not stop.is_set():
+            try:
+                assert r.execute(
+                    "select count(*) from orders").rows() == [(15000,)]
+                ok.append(1)
+            except Exception as e:  # noqa: BLE001
+                failed.append(f"{type(e).__name__}: {e}")
+
+    try:
+        r.execute("select count(*) from orders")  # warm up
+        th = threading.Thread(target=load, daemon=True)
+        th.start()
+        summaries = r.rolling_restart()
+        stop.set()
+        th.join(60)
+        assert len(summaries) == 2
+        assert failed == [], failed
+        assert len(ok) > 0
+        # every slot was replaced and is ACTIVE in the workers table again
+        states = r.execute(
+            "select state from system.runtime.workers").rows()
+        assert [s for (s,) in states].count("ACTIVE") == 2
+        drains = [e for e in r.resilience_events if e[0] == "drain"]
+        assert sum(1 for e in drains if e[2] == "started") == 2
+        assert sum(1 for e in drains if e[2] == "drained") == 2
+    finally:
+        r.close()
+
+
+# ------------------------------------------------------------- chaos soak
+def test_chaos_smoke_fixed_seed():
+    """Fast deterministic tier-1 gate: two in-process scenarios (10
+    queries) from a fixed seed — every query oracle-correct, retried, or
+    correctly classified; zero hangs.  Runs in a subprocess under the
+    soak's own single-device env: that replicates exactly the certified
+    ``bench.py --chaos`` environment, and keeps the scenarios' extra
+    jitted programs out of this process's XLA backend (the accumulated
+    compile load otherwise destabilizes later compiles in the suite)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from trino_tpu.testing.chaos import _ENV
+
+    prog = (
+        "import json\n"
+        "from trino_tpu.testing.chaos import build_expected, run_scenario\n"
+        "expected = build_expected()\n"
+        "recs = [run_scenario(s, mode='inproc', n_queries=5,"
+        " expected=expected) for s in (1009, 1010)]\n"
+        "print(json.dumps([{'seed': r['seed'], 'counts': r['counts'],"
+        " 'n': len(r['outcomes'])} for r in recs]))\n"
+    )
+    env = {**os.environ, **_ENV}
+    out = subprocess.run([sys.executable, "-c", prog], env=env,
+                         capture_output=True, text=True, timeout=300,
+                         cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = json.loads(out.stdout.splitlines()[-1])
+    assert [r["seed"] for r in recs] == [1009, 1010]
+    assert sum(r["n"] for r in recs) == 10
+    assert sum(r["counts"].get("hang", 0) for r in recs) == 0, \
+        "chaos smoke produced a hang"
+    assert sum(r["counts"].get("unexpected", 0) for r in recs) == 0, \
+        "chaos smoke produced an unaccounted outcome"
+
+
+@pytest.mark.slow
+def test_chaos_soak_full():
+    """The full 25-scenario randomized soak (bench.py --chaos writes the
+    same campaign to BENCH_r09.json)."""
+    from trino_tpu.testing.chaos import run_chaos
+
+    summary = run_chaos(n_scenarios=25, base_seed=1009, verbose=False)
+    assert summary["hangs"] == 0
+    assert summary["unexpected"] == 0
+    assert summary["all_accounted"]
+    assert summary["n_queries"] >= 25

@@ -61,6 +61,11 @@ _TABLES = {
         ("task_index", BIGINT), ("worker", VARCHAR), ("state", VARCHAR),
         ("wall_ms", DOUBLE), ("error", VARCHAR),
     ]),
+    "runtime.workers": _schema("runtime.workers", [
+        ("worker", VARCHAR), ("state", VARCHAR),
+        ("blacklist_score", DOUBLE), ("running_tasks", BIGINT),
+        ("queued_tasks", BIGINT), ("last_heartbeat_age_ms", DOUBLE),
+    ]),
     "metrics.counters": _schema("metrics.counters", [
         ("name", VARCHAR), ("kind", VARCHAR), ("value", DOUBLE),
     ]),
@@ -161,6 +166,8 @@ class SystemConnector(Connector):
                  t.state, t.wall_ms, t.error)
                 for t in runtime.tasks()
             ]
+        if table == "runtime.workers":
+            return self._worker_rows()
         if table == "metrics.counters":
             out = []
             for name, snap in metrics.REGISTRY.snapshot().items():
@@ -177,3 +184,44 @@ class SystemConnector(Connector):
                     out.append((name, kind, float(snap["value"])))
             return out
         raise KeyError(f"no such system table: {table!r}")
+
+    def _worker_rows(self) -> list[tuple]:
+        """Per-worker operational view: failure-detector state, cluster
+        blacklist score, task counts, heartbeat age.  Process runners carry
+        a WorkerFailureDetector (worker_rows feed); the in-process runner
+        synthesizes from discovery (control.py NodeManager), where a drained
+        slot reports SHUTTING_DOWN and a failed pinger reports GONE."""
+        runner = self._runner() if self._runner is not None else None
+        if runner is None:
+            return []
+        bl = getattr(runner, "cluster_blacklist", None)
+        scores = bl.snapshot() if bl is not None else {}
+        fd = getattr(runner, "failure_detector", None)
+        if hasattr(fd, "worker_rows"):
+            return [
+                (r["worker"], r["state"], float(scores.get(r["worker"], 0.0)),
+                 r["running_tasks"], r["queued_tasks"],
+                 r["last_heartbeat_age_ms"])
+                for r in fd.worker_rows()
+            ]
+        nodes = getattr(runner, "nodes", None)
+        if nodes is None:
+            return []
+        import time as _time
+
+        failed = set()
+        try:
+            failed = set(fd.failed_nodes())
+        except Exception:
+            pass
+        now = _time.monotonic()
+        out = []
+        for n in nodes.all_nodes():
+            if n.coordinator:
+                continue
+            state = ("GONE" if n.node_id in failed
+                     else "SHUTTING_DOWN" if n.draining else "ACTIVE")
+            out.append((n.node_id, state,
+                        float(scores.get(n.node_id, 0.0)), 0, 0,
+                        (now - n.last_heartbeat) * 1000.0))
+        return out

@@ -102,6 +102,17 @@ class Driver:
                         self._emit(i, page)
                         progressed = True
                 if cur.is_finished() and not nxt.input_done:
+                    if i + 2 == n:
+                        # pre-finish barrier: deferred masked-lane errors
+                        # must surface BEFORE the sink marks its stream
+                        # finished — a streaming consumer could otherwise
+                        # observe a complete, "successful" result (NULL
+                        # lanes) from a task that is about to fail
+                        from ..ops.expr import check_error_scalars
+
+                        check_error_scalars([
+                            e for op in ops
+                            for e in getattr(op, "pending_errors", ())])
                     t0 = time.perf_counter() if timed else 0.0
                     nxt.finish_input()
                     if timed:

@@ -278,6 +278,16 @@ class NodeManager:
             if node_id in self._nodes:
                 self._nodes[node_id].draining = True
 
+    def restore(self, node_id: str) -> None:
+        """Undo drain: the node takes new task placements again (the
+        in-process rolling-restart drill drains and restores each worker
+        in turn — there is no process to replace)."""
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is not None:
+                info.draining = False
+                info.last_heartbeat = time.monotonic()
+
     def remove(self, node_id: str) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)

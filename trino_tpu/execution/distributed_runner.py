@@ -93,6 +93,19 @@ class DistributedQueryRunner:
 
         self.resilience = ResilienceStats()
         self.resilience_events: list = []
+        # cross-query worker blacklist: per-query blacklists die with their
+        # query, so a flaky worker would get a task from every new query —
+        # this one is coordinator-held, TTL-decayed, and consulted by task
+        # placement (remote) / speculation stats across queries
+        from .speculation import ClusterBlacklist
+
+        self.cluster_blacklist = ClusterBlacklist(
+            ttl_s=self.session.blacklist_ttl_s,
+            threshold=self.session.blacklist_threshold)
+        # cumulative speculation outcome counters (per-query details go to
+        # resilience_events)
+        self.speculative_starts = 0
+        self.speculative_wins = 0
         # cumulative count of fused-stage overflow fallbacks (whole-stage
         # compilation re-running a subplan on the legacy per-operator path)
         self.fused_fallbacks = 0
@@ -234,6 +247,12 @@ class DistributedQueryRunner:
                         self.resilience.blacklisted_workers += 1
                         self.resilience_events.append(
                             ("blacklist", te.remote_host, te.code.name))
+                    if te.remote_host:
+                        # score the failure cross-query too: enough strikes
+                        # within the TTL and the worker stops receiving
+                        # tasks from NEW queries as well
+                        self.cluster_blacklist.record_failure(
+                            te.remote_host, reason=te.code.name)
                     self._prepare_retry()
                     backoff.failure()
                     delay = backoff.delay_s
@@ -369,20 +388,79 @@ class DistributedQueryRunner:
             # span via explicit cross-thread parenting (tracing.py parent=)
             parent_span = self.tracer.current()
             qrec = _rt.current_record()
+            # streaming straggler speculation (leaf stages only): a leaf
+            # twin re-reads its splits from the connector; a non-leaf twin
+            # would need its producers' pages back, but the streaming
+            # exchange frees them on ack — that retention is what FTE's
+            # durable spool provides, so non-leaf speculation stays with
+            # retry_policy=TASK (see execution/speculation.py)
+            from .speculation import (
+                SPECULATIVE,
+                STANDARD,
+                StreamingSpeculation,
+                speculation_enabled,
+            )
+
+            spec: Optional[StreamingSpeculation] = None
+            spec_gates: dict = {}
+            if speculation_enabled(self.session):
+                from ..planner.plan import TableWriter
+
+                def _writes(node) -> bool:
+                    return isinstance(node, TableWriter) or any(
+                        _writes(c) for c in node.children)
+
+                spec = StreamingSpeculation(
+                    lag_multiplier=self.session.speculation_lag_multiplier,
+                    min_delay_s=self.session.speculation_min_delay_s,
+                    events=self.resilience_events)
+                for f in fragments:
+                    if (f.source_fragments or f.id in edges
+                            or stages[f.id].task_count < 2
+                            or _writes(f.root)):
+                        continue  # twin needs re-readable, side-effect-free
+                    spec.register_stage(f.id, stages[f.id].task_count)
+                    for t in range(stages[f.id].task_count):
+                        spec_gates[(f.id, t)] = spec.register_task(f.id, t)
             threads: list[threading.Thread] = []
             for f in fragments:
                 stage = stages[f.id]
                 for t in range(stage.task_count):
+                    ctx = None
+                    if (f.id, t) in spec_gates:
+                        ctx = {"gate": spec_gates[(f.id, t)],
+                               "kind": STANDARD,
+                               "cancel": spec.cancel_event(f.id, t, STANDARD)}
                     th = threading.Thread(
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
-                              edges, attempt, parent_span, qrec, mem_qid),
+                              edges, attempt, parent_span, qrec, mem_qid,
+                              ctx),
                         name=f"task-{f.id}.{t}",
                         daemon=True,
                     )
                     threads.append(th)
             for th in threads:
                 th.start()
+
+            def _spawn_twin(fid: int, t: int) -> threading.Thread:
+                # twin attempts use attempt+1000 (mirrors fte.py's
+                # SPECULATIVE attempt base) so attempt-scoped injector
+                # rules do not refire on the twin
+                twin_ctx = {"gate": spec_gates[(fid, t)],
+                            "kind": SPECULATIVE,
+                            "cancel": spec.cancel_event(fid, t, SPECULATIVE)}
+                tw = threading.Thread(
+                    target=self._run_task,
+                    args=(stages[fid], t, stages, errors, stats_sink,
+                          edges, attempt + 1000, parent_span, qrec,
+                          mem_qid, twin_ctx),
+                    name=f"task-{fid}.{t}-speculative",
+                    daemon=True,
+                )
+                tw.start()
+                return tw
+
             from .task import STALL_TIMEOUT_S
 
             # polled join (not a plain join) so an OOM-killer verdict can
@@ -393,6 +471,8 @@ class DistributedQueryRunner:
             while pending and time.monotonic() < deadline:
                 pending[0].join(timeout=0.1)
                 pending = [th for th in pending if th.is_alive()]
+                if spec is not None and not errors and not aborted:
+                    pending.extend(spec.tick(_spawn_twin))
                 if not aborted and handle.poll() is not None:
                     aborted = True
                     for s in stages.values():
@@ -401,6 +481,9 @@ class DistributedQueryRunner:
                     for ex in edges.values():
                         ex.abort()
             hung = [th.name for th in pending if th.is_alive()]
+            if spec is not None:
+                self.speculative_starts += spec.starts
+                self.speculative_wins += spec.wins
         kerr = handle.killed_error()
         if errors or hung or kerr is not None:
             for s in stages.values():
@@ -539,6 +622,27 @@ class DistributedQueryRunner:
             stats_sink.append(stats)
         return writer.committed
 
+    # ----------------------------------------------------------------- drain
+    def drain_worker(self, node_id: str) -> dict:
+        """Coordinator-driven graceful drain of an in-process worker slot:
+        mark it draining in discovery so ``active_worker_count`` (and hence
+        every NEW query's task placement) stops using it.  In-process tasks
+        share the coordinator's address space, so running work simply
+        completes; there is no process to wait on or replace."""
+        from ..telemetry import metrics as tm
+
+        tm.DRAINS.inc()
+        self.resilience_events.append(("drain", node_id, "started"))
+        self.nodes.drain(node_id)
+        self.resilience_events.append(("drain", node_id, "drained"))
+        return {"worker": node_id, "escalated": False}
+
+    def restore_worker(self, node_id: str) -> None:
+        """Undo an in-process drain (the rolling-restart drill's stand-in
+        for booting a replacement process)."""
+        self.nodes.restore(node_id)
+        self.resilience_events.append(("drain", node_id, "restored"))
+
     @property
     def active_worker_count(self) -> int:
         """Live, non-draining workers per discovery + failure detection;
@@ -588,7 +692,10 @@ class DistributedQueryRunner:
                     collective: dict,
                     attempt: int = 0,
                     memory_owner: Optional[str] = None,
+                    spec_ctx: Optional[dict] = None,
                     ) -> tuple[list, Optional[QueryStats]]:
+        from .speculation import SpeculationLost
+
         f = stage.fragment
         # engine-level fault injection on the in-process streaming path,
         # keyed by (fragment, task, attempt) exactly like the FTE path —
@@ -597,7 +704,17 @@ class DistributedQueryRunner:
         if injector is not None:
             from .failure_injector import TASK_FAILURE
 
-            injector.maybe_stall(f.id, task_index, attempt)
+            cancel = spec_ctx["cancel"] if spec_ctx is not None else None
+            injector.maybe_stall(
+                f.id, task_index, attempt,
+                # an injected stall must not outlive its query: bail as soon
+                # as the task's buffer is aborted (query failed / OOM-killed)
+                # or a speculative twin won the race
+                should_cancel=lambda: (
+                    stage.buffers[task_index].aborted
+                    or (cancel is not None and cancel.is_set())))
+            if cancel is not None and cancel.is_set():
+                raise SpeculationLost(spec_ctx["kind"])
             injector.maybe_fail(TASK_FAILURE, f.id, task_index, attempt)
         clients = {}
         for src in f.source_fragments:
@@ -643,8 +760,17 @@ class DistributedQueryRunner:
             sink = CollectiveOutputSink(ex, task_index)
         else:
             local = planner.plan(f.root)
+            out = stage.buffers[task_index]
+            if spec_ctx is not None:
+                # racing attempts write through the task's gate: the first
+                # page (or empty finish) claims the stream, the loser's
+                # first write raises SpeculationLost — downstream consumers
+                # only ever see one attempt's pages
+                from .speculation import GatedBuffer
+
+                out = GatedBuffer(out, spec_ctx["gate"], spec_ctx["kind"])
             sink = PartitionedOutputSink(
-                stage.buffers[task_index],
+                out,
                 f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
                 f.output_keys, serde=self.session.exchange_serde)
         local.pipelines[-1][-1] = sink
@@ -741,12 +867,14 @@ class DistributedQueryRunner:
                   stats_sink: Optional[list] = None,
                   collective: Optional[dict] = None,
                   attempt: int = 0, parent_span=None,
-                  query_record=None, memory_owner=None) -> None:
+                  query_record=None, memory_owner=None,
+                  spec_ctx: Optional[dict] = None) -> None:
         import time as _time
 
         from ..exec.driver import collect_scan_stats
         from ..telemetry import metrics as tm
         from ..telemetry import runtime as rt
+        from .speculation import SpeculationLost
         from .tracing import annotate_scan_span
 
         tm.TASKS_CREATED.inc()
@@ -765,24 +893,40 @@ class DistributedQueryRunner:
             try:
                 pipelines, stats = self._build_task(
                     stage, task_index, stages, stats_sink, collective or {},
-                    attempt, memory_owner=memory_owner)
+                    attempt, memory_owner=memory_owner, spec_ctx=spec_ctx)
                 run_pipelines(pipelines, stats)
+            except SpeculationLost:
+                # this attempt lost the first-commit race — its twin owns
+                # the output stream; unwind without touching the query
+                state = "CANCELED"
+                sp.set("speculation.lost", True)
             except BaseException as e:  # noqa: BLE001 — surfaced to
                 # coordinator
-                errors.append(e)
-                state = "FAILED"
-                err = f"{type(e).__name__}: {e}"
-                sp.set("error", type(e).__name__)
-                # unblock every sibling immediately: producers stuck in
-                # enqueue backpressure, consumers polling this (now dead)
-                # task, and partners parked at a collective all_to_all
-                # barrier would otherwise wait out the full join timeout
-                # before the real error surfaces
-                for s in stages.values():
-                    for b in s.buffers:
-                        b.abort()
-                for ex in (collective or {}).values():
-                    ex.abort()
+                gate = spec_ctx["gate"] if spec_ctx is not None else None
+                if gate is not None and gate.owner is not None \
+                        and gate.owner != spec_ctx["kind"]:
+                    # a loser failing for real changes nothing: the other
+                    # attempt owns the stream and is still healthy
+                    state = "CANCELED"
+                    sp.set("speculation.lost", True)
+                    self.resilience_events.append(
+                        ("speculative_loser_error", stage.fragment.id,
+                         task_index, type(e).__name__))
+                else:
+                    errors.append(e)
+                    state = "FAILED"
+                    err = f"{type(e).__name__}: {e}"
+                    sp.set("error", type(e).__name__)
+                    # unblock every sibling immediately: producers stuck in
+                    # enqueue backpressure, consumers polling this (now
+                    # dead) task, and partners parked at a collective
+                    # all_to_all barrier would otherwise wait out the full
+                    # join timeout before the real error surfaces
+                    for s in stages.values():
+                        for b in s.buffers:
+                            b.abort()
+                    for ex in (collective or {}).values():
+                        ex.abort()
             ingest = collect_scan_stats(pipelines) if pipelines else None
             if ingest is not None:
                 annotate_scan_span(sp, ingest)

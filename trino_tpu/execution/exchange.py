@@ -35,6 +35,8 @@ class OutputBuffer:
         self._aborted = False
         self._bytes = 0
         self._cv = threading.Condition()
+        self.pages_enqueued = 0
+        self.rows_enqueued = 0
 
     def enqueue(self, partition: int, batch: ColumnBatch) -> None:
         with self._cv:
@@ -44,6 +46,9 @@ class OutputBuffer:
                 return
             self._pages[partition].append(batch)
             self._bytes += batch.nbytes
+            self.pages_enqueued += 1
+            # wire relays enqueue SerializedPage, which carries no row count
+            self.rows_enqueued += getattr(batch, "num_rows", 0)
             self._cv.notify_all()
 
     def set_finished(self) -> None:
@@ -57,6 +62,20 @@ class OutputBuffer:
             self._pages = [[] for _ in range(self.num_partitions)]
             self._bytes = 0
             self._cv.notify_all()
+
+    @property
+    def aborted(self) -> bool:
+        return self._aborted
+
+    @property
+    def drained(self) -> bool:
+        """True once the producer finished AND every page has been acked
+        away — the point at which a draining worker may drop the task
+        without losing unfetched output."""
+        with self._cv:
+            if self._aborted:
+                return True
+            return self._finished and not any(self._pages)
 
     def get(self, partition: int, token: int, timeout: float = 10.0
             ) -> tuple[list[ColumnBatch], int, bool]:

@@ -171,6 +171,31 @@ class WorkerFailureDetector:
         with self._lock:
             return {n.node_id: n.state for n in self._nodes.values()}
 
+    def worker_rows(self) -> list[dict]:
+        """Per-worker operational snapshot for ``system.runtime.workers``:
+        detector state, task counts from the cached /v1/status payload, and
+        heartbeat age.  Blacklist scores are joined in by the caller (they
+        live on the coordinator's ClusterBlacklist, not here)."""
+        now = self._clock()
+        with self._lock:
+            out = []
+            for n in self._nodes.values():
+                tasks = ((n.last_status or {}).get("tasks") or {})
+                running = sum(1 for s in tasks.values()
+                              if s.get("state") == "RUNNING"
+                              and s.get("ready", True))
+                queued = sum(1 for s in tasks.values()
+                             if s.get("state") == "RUNNING"
+                             and not s.get("ready", True))
+                out.append({
+                    "worker": n.node_id,
+                    "state": n.state,
+                    "running_tasks": running,
+                    "queued_tasks": queued,
+                    "last_heartbeat_age_ms": (now - n.last_seen) * 1000.0,
+                })
+            return out
+
     # ------------------------------------------------- background monitoring
     def start(self) -> None:
         if self._thread is not None:

@@ -22,7 +22,8 @@ from typing import Optional
 
 __all__ = ["FailureInjector", "InjectedFailure",
            "TASK_FAILURE", "GET_RESULTS_FAILURE", "PROCESS_EXIT",
-           "TASK_STALL", "TASK_OOM"]
+           "TASK_STALL", "TASK_OOM",
+           "match_wire_rule", "check_wire_rules", "sleep_with_cancel"]
 
 TASK_FAILURE = "TASK_FAILURE"
 GET_RESULTS_FAILURE = "GET_RESULTS_FAILURE"
@@ -96,7 +97,8 @@ class FailureInjector:
                     r.fired += 1
                     out.append({"kind": r.kind, "fragment_id": fragment_id,
                                 "task_index": task_index,
-                                "attempt": attempt})
+                                "attempt": attempt,
+                                "stall_s": r.stall_s})
         return out
 
     def maybe_fail(self, kind: str, fragment_id: int, task_index: int,
@@ -120,9 +122,12 @@ class FailureInjector:
                         f"attempt {attempt}")
 
     def maybe_stall(self, fragment_id: int, task_index: int,
-                    attempt: int = 0) -> None:
+                    attempt: int = 0, should_cancel=None) -> None:
         """Sleep (outside the lock) when a TASK_STALL rule matches — the
-        deterministic straggler for speculative-execution tests."""
+        deterministic straggler for speculative-execution tests.  The sleep
+        polls ``should_cancel`` every 50ms so a stall cannot outlive its
+        query: a cancelled/aborted/speculatively-lost task exits the stall
+        immediately instead of wedging a drain or OOM-kill."""
         delay = 0.0
         with self._lock:
             for r in self.rules:
@@ -131,17 +136,32 @@ class FailureInjector:
                     r.fired += 1
                     delay = max(delay, r.stall_s)
         if delay:
-            import time
-
-            time.sleep(delay)
+            sleep_with_cancel(delay, should_cancel)
 
 
-def check_wire_rules(rules: list[dict], kind: str, fragment_id: int,
-                     task_index: int, attempt: int) -> Optional[str]:
+def sleep_with_cancel(delay: float, should_cancel=None,
+                      slice_s: float = 0.05) -> bool:
+    """Sleep up to ``delay`` seconds in small slices, bailing out as soon
+    as ``should_cancel()`` turns true.  Returns True if cancelled early."""
+    import time
+
+    if should_cancel is None:
+        time.sleep(delay)
+        return False
+    deadline = time.monotonic() + delay
+    while time.monotonic() < deadline:
+        if should_cancel():
+            return True
+        time.sleep(min(slice_s, max(0.0, deadline - time.monotonic())))
+    return bool(should_cancel())
+
+
+def match_wire_rule(rules: list[dict], kind: str, fragment_id: int,
+                    task_index: int, attempt: int) -> Optional[dict]:
     """Worker-side rule match over descriptor-carried rules.  Returns the
-    matched kind (the caller decides how to die) or None.  Attempt-scoped
-    rules make one-shot semantics deterministic without shared state: the
-    retry carries attempt+1 which no longer matches."""
+    full matched rule dict (so callers can read ``stall_s`` etc.) or None.
+    Attempt-scoped rules make one-shot semantics deterministic without
+    shared state: the retry carries attempt+1 which no longer matches."""
     for r in rules:
         if (r["kind"] == kind
                 and (r["fragment_id"] is None
@@ -149,5 +169,11 @@ def check_wire_rules(rules: list[dict], kind: str, fragment_id: int,
                 and (r["task_index"] is None
                      or r["task_index"] == task_index)
                 and (r["attempt"] is None or r["attempt"] == attempt)):
-            return r["kind"]
+            return r
     return None
+
+
+def check_wire_rules(rules: list[dict], kind: str, fragment_id: int,
+                     task_index: int, attempt: int) -> Optional[str]:
+    r = match_wire_rule(rules, kind, fragment_id, task_index, attempt)
+    return r["kind"] if r is not None else None

@@ -345,15 +345,19 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                            ) -> list[WorkerProcess]:
         """Task placement targets: live worker processes whose heartbeat
         state is ACTIVE (draining and unresponsive nodes get no new tasks),
-        minus the query's blacklist.  Falls back to ignoring the blacklist
-        rather than returning nothing (a 1-worker cluster must still place
-        after a blacklisting retry)."""
+        minus the query's blacklist, minus workers the cross-query
+        ClusterBlacklist currently scores past its threshold.  Falls back to
+        progressively ignoring the cluster then the query blacklist rather
+        than returning nothing (a 1-worker cluster must still place after a
+        blacklisting retry)."""
         self.failure_detector.maybe_sweep()
         states = self.failure_detector.states()
         live = [w for w in self.workers
                 if w.alive() and states.get(w.url, "ACTIVE") == "ACTIVE"]
         placeable = [w for w in live if w.url not in blacklist]
-        return placeable or live
+        cluster_bl = self.cluster_blacklist.blacklisted()
+        preferred = [w for w in placeable if w.url not in cluster_bl]
+        return preferred or placeable or live
 
     @property
     def active_worker_count(self) -> int:
@@ -392,6 +396,82 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         """Between query-retry attempts: sweep heartbeats and respawn GONE
         workers so the re-run sees healed capacity."""
         self._replace_gone_workers()
+
+    # --------------------------------------------------------------- drain
+    def drain_worker(self, worker, timeout_s: Optional[float] = None,
+                     replace: bool = True) -> dict:
+        """Coordinator-driven graceful drain of one worker process.
+
+        Protocol: PUT /v1/shutdown?timeout_s=N flips the worker to
+        SHUTTING_DOWN (it refuses new tasks with 503; the next heartbeat
+        sweep + placement stop scheduling to it — a 503 on task create
+        surfaces as a retryable classified error, so retry_policy=QUERY
+        migrates not-yet-started work automatically).  The worker exits on
+        its own once every running task is terminal AND its output buffers
+        are fully drained; past the budget it abandons the stragglers (exit
+        code 9) and, if even the process lingers, the coordinator escalates
+        with a hard kill.  The failure detector is swept synchronously
+        before any replacement boots so in-flight queries observe
+        REMOTE_HOST_GONE (and retry) instead of spinning on exchange
+        backoff.  Operator-initiated: the replacement does NOT count
+        against ``max_worker_replacements``."""
+        import subprocess as _subprocess
+
+        from ..telemetry import metrics as tm
+        from .speculation import drain_timeout_s as _drain_budget
+
+        if isinstance(worker, str):
+            matches = [w for w in self.workers if w.url == worker]
+            if not matches:
+                raise KeyError(f"no such worker: {worker}")
+            w = matches[0]
+        else:
+            w = worker
+        budget = (float(timeout_s) if timeout_s is not None
+                  else _drain_budget(self.session, 30.0))
+        tm.DRAINS.inc()
+        self.resilience_events.append(("drain", w.url, "started"))
+        try:
+            _http("PUT", f"{w.url}/v1/shutdown?timeout_s={budget:g}",
+                  timeout=5.0).read()
+        except Exception:
+            pass  # already dead: the sweeps below classify it
+        # observe SHUTTING_DOWN promptly so placement excludes the worker
+        # from this moment on, not from the next opportunistic sweep
+        self.failure_detector.sweep_once()
+        escalated = False
+        try:
+            w.proc.wait(timeout=budget + 5.0)
+        except _subprocess.TimeoutExpired:
+            escalated = True
+            self.resilience_events.append(("drain", w.url, "escalated"))
+            w.kill()
+        # the process is gone: land GONE in the detector BEFORE a
+        # replacement exists, so concurrent queries classify and retry
+        self.failure_detector.sweep_once()
+        summary = {"worker": w.url, "escalated": escalated,
+                   "exit_code": w.proc.poll(), "replacement": None}
+        if replace:
+            slot = self.workers.index(w)
+            replacement = WorkerProcess(self._env_overrides)
+            self.failure_detector.unmonitor(w.url)
+            self._monitor_worker(replacement)
+            self.workers[slot] = replacement
+            self.failure_detector.sweep_once()
+            self.resilience_events.append(
+                ("drain", w.url, "replaced", replacement.url))
+            summary["replacement"] = replacement.url
+        self.resilience_events.append(("drain", w.url, "drained"))
+        return summary
+
+    def rolling_restart(self, timeout_s: Optional[float] = None
+                        ) -> list[dict]:
+        """Drain + replace every worker slot, one at a time — the rolling
+        restart drill.  Under retry_policy=QUERY this loses zero queries:
+        capacity shrinks by one worker per step, never to zero."""
+        return [self.drain_worker(self.workers[i], timeout_s=timeout_s,
+                                  replace=True)
+                for i in range(len(self.workers))]
 
     def close(self) -> None:
         self.failure_detector.stop()
@@ -488,10 +568,35 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
     def _run_streaming(self, subplan: SubPlan, stats_sink: Optional[list],
                        attempt: int = 0,
                        blacklist: frozenset = frozenset()) -> QueryResult:
+        # cluster-state system tables (system.runtime.workers / queries /
+        # metrics.counters) are coordinator-fed: the attached runner and
+        # failure detector live in THIS process, not in any worker, so a
+        # subplan whose scans all read catalog "system" executes in-process
+        # — the analogue of Trino's coordinator-only system splits
+        if self._scans_system_only(subplan):
+            return super()._run_streaming(subplan, stats_sink,
+                                          attempt=attempt,
+                                          blacklist=blacklist)
         # the base class dispatches retry_policy (TASK -> fte, QUERY -> the
         # query-retry loop); both land here for the actual remote run
         return self._run_remote(subplan, attempt=attempt,
                                 blacklist=blacklist)
+
+    @staticmethod
+    def _scans_system_only(subplan: SubPlan) -> bool:
+        from ..planner.plan import TableScan
+
+        scans: list = []
+
+        def walk(n) -> None:
+            if isinstance(n, TableScan):
+                scans.append(n)
+            for c in n.children:
+                walk(c)
+
+        for f in subplan.all_fragments():
+            walk(f.root)
+        return bool(scans) and all(s.catalog == "system" for s in scans)
 
     def _exchange_backoff_cfg(self) -> dict:
         sess = self.session
@@ -507,11 +612,15 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         x N tasks against one hung worker)."""
         self.failure_detector.sweep_once()
         for wurl, owned in by_worker.items():
-            if self.failure_detector.state_of(wurl) == GONE:
+            # state None means the worker was unmonitored mid-query (a
+            # drain replaced it) — without this an in-flight query would
+            # spin on exchange backoff against a vanished process until the
+            # query deadline instead of retrying promptly
+            if self.failure_detector.state_of(wurl) in (GONE, None):
                 raise TrinoError(
                     REMOTE_HOST_GONE,
                     f"worker {wurl} ({len(owned)} tasks): "
-                    f"{self.failure_detector.last_error(wurl)}",
+                    f"{self.failure_detector.last_error(wurl) or 'replaced'}",
                     remote_host=wurl)
             status = self.failure_detector.last_status(wurl) or {}
             # the same cached status JSON feeds the cluster memory view:

@@ -1,0 +1,343 @@
+"""Streaming-path straggler speculation + the cross-query cluster blacklist.
+
+Extends the FTE speculative-twin machinery (execution/fte.py run_stage —
+reference: TaskExecutionClass.java:19 STANDARD/SPECULATIVE) to the streaming
+pipelined scheduler: once half of a stage's tasks have committed, a task
+whose wall time exceeds ``max(lag_multiplier x stage median, min_delay)``
+without producing a single page gets a SPECULATIVE twin.  The twin races the
+primary under first-commit-wins: both attempts write through a
+:class:`TaskGate` guarding the task's shared OutputBuffer — the first
+attempt to enqueue a page (or finish empty) owns the stream, the loser's
+first write raises :class:`SpeculationLost` and its attempt unwinds quietly
+(no query error, no double-commit: every page of exactly one attempt flows
+downstream).
+
+Scope: tasks whose fragment has no remote sources (leaf stages) and whose
+sink is a plain OutputBuffer.  A non-leaf streaming twin would have to
+re-read its producers' page streams, but the streaming exchange frees pages
+on ack (execution/exchange.py) — there is nothing durable to re-read.  That
+retention is exactly what FTE's spool buys, so non-leaf speculation stays an
+FTE (retry_policy=TASK) capability; MapReduce draws the same line (maps
+re-execute from durable input; reducers re-read retained map output —
+Dean & Ghemawat, OSDI'04).
+
+:class:`ClusterBlacklist` is the coordinator-held, cross-query companion:
+the per-query retry blacklist (distributed_runner._run_query_retry) dies
+with the query, so a flaky worker gets one task from EVERY new query.  Here
+each recorded failure scores against the worker with a TTL; once the decayed
+score crosses the threshold the worker stops receiving tasks across queries
+(execution/remote.py _placement_workers) until its entries expire.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["ClusterBlacklist", "SpeculationLost", "TaskGate", "GatedBuffer",
+           "StreamingSpeculation", "speculation_enabled", "drain_timeout_s",
+           "STANDARD", "SPECULATIVE"]
+
+STANDARD = "STANDARD"
+SPECULATIVE = "SPECULATIVE"
+
+
+def speculation_enabled(session) -> bool:
+    """Session tri-state first (SET SESSION speculation = true), then the
+    TRINO_TPU_SPECULATION env knob; off by default."""
+    v = getattr(session, "speculation", None)
+    if v is None:
+        return os.environ.get("TRINO_TPU_SPECULATION", "0").strip().lower() \
+            in ("1", "true", "on")
+    return bool(v)
+
+
+def drain_timeout_s(session=None, default: float = 30.0) -> float:
+    """Bounded graceful-drain budget: session knob, then
+    TRINO_TPU_DRAIN_TIMEOUT_S, then ``default``."""
+    v = getattr(session, "drain_timeout_s", None) if session is not None \
+        else None
+    if v:
+        return float(v)
+    env = os.environ.get("TRINO_TPU_DRAIN_TIMEOUT_S")
+    return float(env) if env else float(default)
+
+
+class SpeculationLost(Exception):
+    """Raised inside a racing attempt whose twin already claimed the task's
+    output gate; the attempt unwinds without reporting a query error."""
+
+
+class TaskGate:
+    """First-commit-wins ownership of one task's output stream.  ``claim``
+    is called on every write: the first caller becomes the owner, later
+    callers of the other kind are losers.  ``finish`` marks the owning
+    attempt complete (feeds the stage-median straggler cutoff)."""
+
+    def __init__(self, on_claim: Optional[Callable[[str], None]] = None,
+                 on_finish: Optional[Callable[[str], None]] = None):
+        self._lock = threading.Lock()
+        self.owner: Optional[str] = None
+        self.finished = False
+        self._on_claim = on_claim
+        self._on_finish = on_finish
+
+    def claim(self, kind: str) -> bool:
+        first = False
+        with self._lock:
+            if self.owner is None:
+                self.owner = kind
+                first = True
+            ok = self.owner == kind
+        if first and self._on_claim is not None:
+            self._on_claim(kind)
+        return ok
+
+    def finish(self, kind: str) -> None:
+        with self._lock:
+            if self.owner != kind or self.finished:
+                return
+            self.finished = True
+        if self._on_finish is not None:
+            self._on_finish(kind)
+
+
+class GatedBuffer:
+    """OutputBuffer facade for one racing attempt: every write must hold the
+    gate.  The loser's first write raises :class:`SpeculationLost`, so all
+    pages downstream consumers ever see come from exactly one attempt (the
+    sink-buffer byte accounting never sees the loser either)."""
+
+    def __init__(self, inner, gate: TaskGate, kind: str):
+        self._inner = inner
+        self._gate = gate
+        self.kind = kind
+
+    @property
+    def num_partitions(self) -> int:
+        return self._inner.num_partitions
+
+    @property
+    def aborted(self) -> bool:
+        return self._inner.aborted
+
+    def enqueue(self, partition: int, batch) -> None:
+        if not self._gate.claim(self.kind):
+            raise SpeculationLost(self.kind)
+        self._inner.enqueue(partition, batch)
+
+    def set_finished(self) -> None:
+        # an empty output commits here: first to FINISH an empty stream wins
+        if not self._gate.claim(self.kind):
+            raise SpeculationLost(self.kind)
+        self._inner.set_finished()
+        self._gate.finish(self.kind)
+
+    def abort(self) -> None:
+        self._inner.abort()
+
+
+class _TaskTrack:
+    __slots__ = ("gate", "twin_started", "cancel", )
+
+    def __init__(self):
+        # cancel[kind] is set when the OTHER kind wins; racing attempts poll
+        # it from injected stalls (failure_injector.maybe_stall) and before
+        # planning, so a losing straggler exits early instead of sleeping
+        # out its injected stall
+        self.gate: Optional[TaskGate] = None
+        self.twin_started = False
+        self.cancel = {STANDARD: threading.Event(),
+                       SPECULATIVE: threading.Event()}
+
+
+class _StageTrack:
+    __slots__ = ("fid", "tc", "t0", "tasks", "durations")
+
+    def __init__(self, fid: int, tc: int, t0: float):
+        self.fid = fid
+        self.tc = tc
+        self.t0 = t0
+        self.tasks: dict[int, _TaskTrack] = {}
+        self.durations: list[float] = []
+
+
+class StreamingSpeculation:
+    """Per-query controller: tracks eligible stages, detects stragglers on
+    the coordinator's join-poll cadence, and launches twins.  All bookkeeping
+    is query-local; cumulative counters land in telemetry + the runner's
+    resilience event log."""
+
+    def __init__(self, lag_multiplier: float = 2.0,
+                 min_delay_s: float = 0.25,
+                 events: Optional[list] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.lag_multiplier = max(1.0, float(lag_multiplier))
+        self.min_delay_s = float(min_delay_s)
+        self.events = events if events is not None else []
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stages: dict[int, _StageTrack] = {}
+        self.starts = 0
+        self.wins = 0
+
+    # --------------------------------------------------------- registration
+    def register_stage(self, fid: int, tc: int) -> None:
+        with self._lock:
+            self._stages[fid] = _StageTrack(fid, tc, self._clock())
+
+    def register_task(self, fid: int, t: int) -> TaskGate:
+        """Create the task's gate; returns it for sink wrapping."""
+        with self._lock:
+            st = self._stages[fid]
+            tr = _TaskTrack()
+            st.tasks[t] = tr
+        tr.gate = TaskGate(
+            on_claim=lambda kind, _f=fid, _t=t: self._claimed(_f, _t, kind),
+            on_finish=lambda kind, _f=fid, _t=t: self._finished(_f, _t))
+        return tr.gate
+
+    def cancel_event(self, fid: int, t: int, kind: str) -> threading.Event:
+        with self._lock:
+            return self._stages[fid].tasks[t].cancel[kind]
+
+    # ------------------------------------------------------------ callbacks
+    def _claimed(self, fid: int, t: int, kind: str) -> None:
+        from ..telemetry import metrics as tm
+
+        with self._lock:
+            tr = self._stages[fid].tasks[t]
+            had_twin = tr.twin_started
+        loser = STANDARD if kind == SPECULATIVE else SPECULATIVE
+        tr.cancel[loser].set()
+        if kind == SPECULATIVE:
+            with self._lock:
+                self.wins += 1
+            tm.SPECULATIVE_WINS.inc()
+            self.events.append(("speculative_win", fid, t))
+        if had_twin:
+            self.events.append(("speculative_cancelled", fid, t, loser))
+
+    def _finished(self, fid: int, t: int) -> None:
+        now = self._clock()
+        with self._lock:
+            st = self._stages[fid]
+            st.durations.append(now - st.t0)
+
+    # ------------------------------------------------------------ detection
+    def tick(self, spawn: Callable[[int, int], object]) -> list:
+        """One straggler sweep: for every stage with >= half its tasks
+        committed, twin each unclaimed task past the lag cutoff.  ``spawn``
+        launches the SPECULATIVE attempt and returns its thread; the list of
+        new threads is handed back so the join loop tracks them."""
+        from ..telemetry import metrics as tm
+
+        now = self._clock()
+        out = []
+        with self._lock:
+            stages = list(self._stages.values())
+        for st in stages:
+            with self._lock:
+                committed = len(st.durations)
+                if st.tc < 2 or committed * 2 < st.tc:
+                    continue
+                med = sorted(st.durations)[committed // 2]
+                cutoff = max(self.lag_multiplier * med, self.min_delay_s)
+                lagging = [
+                    (t, tr) for t, tr in st.tasks.items()
+                    if tr.gate is not None and tr.gate.owner is None
+                    and not tr.twin_started and now - st.t0 > cutoff
+                ]
+                for _t, tr in lagging:
+                    tr.twin_started = True
+                    self.starts += 1
+            for t, _tr in lagging:
+                tm.SPECULATIVE_STARTS.inc()
+                self.events.append(("speculative_start", st.fid, t))
+                th = spawn(st.fid, t)
+                if th is not None:
+                    out.append(th)
+        return out
+
+
+class ClusterBlacklist:
+    """Coordinator-held cross-query worker blacklist with TTL decay.
+
+    Each failure records ``(timestamp, weight)`` against the worker; the
+    score is the weight sum of unexpired entries, and a worker is
+    blacklisted while ``score >= threshold``.  Entries expire after
+    ``ttl_s`` — a worker that stops failing regains placement without any
+    operator action.  Thread-safe; the ``trino_blacklisted_workers`` gauge
+    tracks the current blacklisted set size."""
+
+    def __init__(self, ttl_s: Optional[float] = None,
+                 threshold: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("TRINO_TPU_BLACKLIST_TTL_S", "300"))
+        if threshold is None:
+            threshold = float(
+                os.environ.get("TRINO_TPU_BLACKLIST_THRESHOLD", "2"))
+        self.ttl_s = float(ttl_s)
+        self.threshold = max(1.0, float(threshold))
+        self._clock = clock
+        self._lock = threading.Lock()
+        # worker -> list of (monotonic ts, weight, reason)
+        self._entries: dict[str, list[tuple[float, float, str]]] = {}
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.ttl_s
+        for w in list(self._entries):
+            kept = [e for e in self._entries[w] if e[0] > horizon]
+            if kept:
+                self._entries[w] = kept
+            else:
+                del self._entries[w]
+
+    def record_failure(self, worker: str, reason: str = "",
+                       weight: float = 1.0) -> float:
+        now = self._clock()
+        with self._lock:
+            self._prune_locked(now)
+            self._entries.setdefault(worker, []).append(
+                (now, float(weight), reason))
+            score = sum(e[1] for e in self._entries[worker])
+        self._refresh_gauge()
+        return score
+
+    def score(self, worker: str) -> float:
+        now = self._clock()
+        with self._lock:
+            self._prune_locked(now)
+            return sum(e[1] for e in self._entries.get(worker, ()))
+
+    def is_blacklisted(self, worker: str) -> bool:
+        return self.score(worker) >= self.threshold
+
+    def blacklisted(self) -> frozenset:
+        now = self._clock()
+        with self._lock:
+            self._prune_locked(now)
+            out = frozenset(
+                w for w, es in self._entries.items()
+                if sum(e[1] for e in es) >= self.threshold)
+        self._refresh_gauge()
+        return out
+
+    def snapshot(self) -> dict[str, float]:
+        """worker -> current score (system.runtime.workers feed)."""
+        now = self._clock()
+        with self._lock:
+            self._prune_locked(now)
+            return {w: sum(e[1] for e in es)
+                    for w, es in self._entries.items()}
+
+    def _refresh_gauge(self) -> None:
+        from ..telemetry import metrics as tm
+
+        with self._lock:
+            n = sum(1 for es in self._entries.values()
+                    if sum(e[1] for e in es) >= self.threshold)
+        tm.BLACKLISTED_WORKERS.set(n)

@@ -31,6 +31,7 @@ import json
 import pickle
 import sys
 import threading
+import time
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -44,6 +45,12 @@ def encode_descriptor(desc: dict) -> bytes:
 
 def decode_descriptor(data: bytes) -> dict:
     return pickle.loads(zlib.decompress(data))
+
+
+class _TaskCanceled(Exception):
+    """Internal unwind signal: the task was cancelled (DELETE or drain
+    escalation) while sitting in an injected stall — terminal state is
+    CANCELED, not FAILED, and no error classification applies."""
 
 
 def build_catalog(spec: dict):
@@ -86,7 +93,11 @@ class _Task:
         out = {"state": self.state, "error": self.error,
                "error_type": self.error_type, "error_code": self.error_code,
                "query_id": self.query_id,
-               "memory_reserved_bytes": reserved}
+               "memory_reserved_bytes": reserved,
+               # progress feed for the coordinator's drain/straggler logic:
+               # planning done + pages produced so far
+               "ready": self.ready.is_set(),
+               "pages_out": getattr(self.buffer, "pages_enqueued", 0)}
         if include_span and self.span is not None:
             out["span"] = self.span
         return out
@@ -101,6 +112,10 @@ class TaskServer:
         self.tasks: dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._draining = False
+        # set when a drain had to abandon running tasks at the deadline —
+        # the process then exits with code 9 (vs 0 for a clean drain) so
+        # the coordinator/operator can tell the two apart
+        self.drain_timed_out = False
         # worker-local span collector: task spans are remote-parented from
         # the coordinator's traceparent header and shipped back (serialized)
         # with task completion
@@ -246,12 +261,37 @@ class TaskServer:
                 "error": t.error, "error_type": t.error_type,
                 "error_code": t.error_code}).encode())
             return
+        if t.state == "CANCELED":
+            # e.g. abandoned by a timed-out drain: report a retryable
+            # EXTERNAL failure so retry_policy=QUERY re-runs the query
+            # instead of waiting on a stream that will never finish
+            h._send(500, json.dumps({
+                "error": t.error or f"task {task_id} canceled on worker",
+                "error_type": "EXTERNAL",
+                "error_code": "REMOTE_TASK_ERROR"}).encode())
+            return
         if not t.ready.wait(timeout=maxwait) or t.buffer is None:
             h._send(200, b"", "application/x-trino-pages",
                     {"X-Next-Token": token, "X-Done": 0})
             return
         pages, next_token, done = t.buffer.get(
             buffer_id, token, timeout=min(maxwait, 1.0))
+        if done and t.buffer.aborted:
+            # an aborted stream NEVER reads as a clean end-of-stream: the
+            # producer is failing or was cancelled, but its thread may not
+            # have recorded the verdict yet (buffer.abort() precedes the
+            # state flip).  Wait briefly for the real error, else report a
+            # retryable transport error — otherwise the consumer completes
+            # the query with a truncated/empty "successful" result.
+            deadline = time.monotonic() + min(maxwait, 2.0)
+            while t.state == "RUNNING" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            h._send(500, json.dumps({
+                "error": t.error or f"task {task_id} output aborted",
+                "error_type": t.error_type or "EXTERNAL",
+                "error_code": t.error_code or "REMOTE_TASK_ERROR",
+            }).encode())
+            return
         body = bytearray()
         for p in pages:
             raw = p.data if hasattr(p, "data") else None
@@ -305,25 +345,61 @@ class TaskServer:
         h._send(404, b'{"error": "not found"}')
 
     def _put(self, h) -> None:
+        from urllib.parse import parse_qs, urlsplit
+
         if not self._authorized(h):
             return
-        parts = [p for p in h.path.split("/") if p]
+        url = urlsplit(h.path)
+        parts = [p for p in url.path.split("/") if p]
         if parts == ["v1", "shutdown"]:
             # graceful drain: refuse new tasks, exit once current ones end
+            # (bounded — ?timeout_s= overrides TRINO_TPU_DRAIN_TIMEOUT_S)
+            import os
+
+            try:
+                timeout_s = float(parse_qs(url.query).get(
+                    "timeout_s",
+                    [os.environ.get("TRINO_TPU_DRAIN_TIMEOUT_S", "300")])[0])
+            except ValueError:
+                timeout_s = 300.0
             self._draining = True
             h._send(200, b'{"state": "SHUTTING_DOWN"}')
-            threading.Thread(target=self._drain_and_exit, daemon=True).start()
+            threading.Thread(target=self._drain_and_exit,
+                             args=(timeout_s,), daemon=True).start()
             return
         h._send(404, b'{"error": "not found"}')
 
-    def _drain_and_exit(self) -> None:
-        import time
+    def _task_drained(self, t: _Task) -> bool:
+        # a task may leave the drain only when it stopped running AND its
+        # unfetched output is gone (fully acked or aborted) — exiting on
+        # state alone would drop pages a consumer has not pulled yet
+        if t.state == "RUNNING":
+            return False
+        b = t.buffer
+        return b is None or b.drained
 
-        deadline = time.monotonic() + 300
+    def _drain_and_exit(self, timeout_s: float = 300.0) -> None:
+        deadline = time.monotonic() + max(0.0, timeout_s)
         while time.monotonic() < deadline:
-            if all(t.state != "RUNNING" for t in self.tasks.values()):
+            if all(self._task_drained(t) for t in list(self.tasks.values())):
                 break
-            time.sleep(0.1)
+            time.sleep(0.05)
+        else:
+            abandoned = [tid for tid, t in list(self.tasks.items())
+                         if not self._task_drained(t)]
+            if abandoned:
+                self.drain_timed_out = True
+                print(f"DRAIN TIMEOUT after {timeout_s:.1f}s "
+                      f"abandoning tasks: {sorted(abandoned)}",
+                      file=sys.stderr, flush=True)
+                for tid in abandoned:
+                    t = self.tasks.get(tid)
+                    if t is None:
+                        continue
+                    if t.state == "RUNNING":
+                        t.state = "CANCELED"
+                    if t.buffer is not None:
+                        t.buffer.abort()
         self.httpd.shutdown()
 
     # ------------------------------------------------------------ execution
@@ -363,8 +439,12 @@ class TaskServer:
                 GET_RESULTS_FAILURE,
                 PROCESS_EXIT,
                 TASK_FAILURE,
+                TASK_OOM,
+                TASK_STALL,
                 InjectedFailure,
                 check_wire_rules,
+                match_wire_rule,
+                sleep_with_cancel,
             )
             from .remote import HttpExchangeClient
             from .task import PartitionedOutputSink
@@ -389,6 +469,22 @@ class TaskServer:
                 raise InjectedFailure(
                     f"injected TASK_FAILURE f{fragment.id}.t{task_index} "
                     f"attempt {attempt}")
+            if check_wire_rules(rules, TASK_OOM, fragment.id, task_index,
+                                attempt):
+                from ..spi.memory import ExceededMemoryLimitError
+
+                raise ExceededMemoryLimitError(
+                    f"injected-oom f{fragment.id}.t{task_index}", 1 << 40, 0)
+            stall = match_wire_rule(rules, TASK_STALL, fragment.id,
+                                    task_index, attempt)
+            if stall is not None and stall.get("stall_s"):
+                # the stall polls the task's cancel flag (DELETE handler /
+                # drain escalation both flip state off RUNNING) so an
+                # injected straggler cannot outlive its query
+                sleep_with_cancel(float(stall["stall_s"]),
+                                  lambda: t.state != "RUNNING")
+                if t.state != "RUNNING":
+                    raise _TaskCanceled()
             if desc.get("upstream") and check_wire_rules(
                     rules, GET_RESULTS_FAILURE, fragment.id, task_index,
                     attempt):
@@ -463,6 +559,14 @@ class TaskServer:
                 t.buffer = out
             t.ready.set()
             run_pipelines(local.pipelines)
+        except _TaskCanceled:
+            state = "CANCELED"
+            sp.set("canceled", True)
+            if t.buffer is not None:
+                t.buffer.abort()
+            if writer is not None:
+                writer.abort()
+            t.ready.set()
         except BaseException as e:  # noqa: BLE001 — reported to coordinator
             from ..spi.errors import classify
 
@@ -527,6 +631,9 @@ def main(argv=None) -> None:
     server = TaskServer(args.port)
     print(f"LISTENING {server.port}", flush=True)
     server.serve_forever()
+    # serve_forever returns when a drain shut the server down; exit code 9
+    # distinguishes "drain abandoned tasks at the deadline" from a clean 0
+    sys.exit(9 if server.drain_timed_out else 0)
 
 
 if __name__ == "__main__":

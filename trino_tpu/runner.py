@@ -90,6 +90,8 @@ SETTABLE_SESSION_PROPERTIES = {
     "heartbeat_interval_s", "heartbeat_failure_threshold",
     "max_worker_replacements", "exchange_backoff_min_s",
     "exchange_backoff_max_s", "exchange_max_failure_duration_s",
+    "speculation", "speculation_lag_multiplier", "speculation_min_delay_s",
+    "blacklist_ttl_s", "blacklist_threshold", "drain_timeout_s",
 }
 
 
@@ -451,6 +453,20 @@ class Session:
     fte_speculative: bool = True
     fte_speculative_delay_s: float = 0.25
     fte_memory_growth: float = 2.0
+    # streaming-path straggler speculation (execution/speculation.py): the
+    # tri-state None defers to TRINO_TPU_SPECULATION; a leaf task whose wall
+    # time exceeds max(lag_multiplier x stage-median, min_delay) without a
+    # committed page gets a racing twin under first-commit-wins
+    speculation: object = None
+    speculation_lag_multiplier: float = 2.0
+    speculation_min_delay_s: float = 0.25
+    # cross-query cluster blacklist (coordinator-held, TTL decay): None
+    # defers to TRINO_TPU_BLACKLIST_TTL_S / TRINO_TPU_BLACKLIST_THRESHOLD
+    blacklist_ttl_s: object = None
+    blacklist_threshold: object = None
+    # coordinator-driven graceful drain budget (None = the
+    # TRINO_TPU_DRAIN_TIMEOUT_S env knob, default 30s coordinator-side)
+    drain_timeout_s: object = None
     # INSERT/CTAS fan out over round-robin writer tasks when the source is
     # large (SCALED_WRITER_* partitionings in miniature; planned by estimate)
     scale_writers: bool = False

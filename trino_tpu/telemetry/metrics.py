@@ -388,6 +388,19 @@ RES_EXCHANGE_BACKOFF_TRIPS = REGISTRY.counter(
     "trino_resilience_exchange_backoff_trips_total",
     "exchange sources declared failed past the failure-duration budget")
 
+# streaming straggler speculation + graceful drain (execution/speculation.py)
+SPECULATIVE_STARTS = REGISTRY.counter(
+    "trino_speculative_starts_total",
+    "speculative twin tasks launched for streaming stragglers")
+SPECULATIVE_WINS = REGISTRY.counter(
+    "trino_speculative_wins_total",
+    "speculative twins that won the first-commit race")
+DRAINS = REGISTRY.counter(
+    "trino_drains_total", "coordinator-driven worker drains started")
+BLACKLISTED_WORKERS = REGISTRY.gauge(
+    "trino_blacklisted_workers",
+    "workers currently blacklisted by the cluster blacklist")
+
 # whole-stage compilation (execution/stage_compiler.py)
 FUSED_STAGES = REGISTRY.counter(
     "trino_fused_stages_total", "fused stage seams executed")

@@ -1,0 +1,304 @@
+"""Randomized fault-injection soak harness ("chaos certification").
+
+Seeded scenario generator that drives the engine-level FailureInjector
+(TASK_FAILURE / TASK_STALL / TASK_OOM / GET_RESULTS_FAILURE /
+PROCESS_EXIT) plus live coordinator-driven drains under a sustained
+TPC-H query mix, and checks the invariant the resilience plane promises:
+
+    every query either returns oracle-correct rows (possibly after a
+    classified retry under retry_policy=QUERY), or fails fast with a
+    correctly classified USER / unretryable error.  Nothing hangs.
+
+Scenarios are a pure function of ``(base_seed, scenario_index)`` —
+``random.Random(seed)`` picks the SQL, the fault kind, the target task
+and the drain victims — so any failing scenario replays exactly from
+its seed.  Two modes:
+
+- ``inproc``  : DistributedQueryRunner (threads), cheap; covers the
+  in-process injection sites, speculation and logical drain/restore.
+- ``process`` : ProcessDistributedQueryRunner (real worker processes),
+  expensive; adds PROCESS_EXIT hard-kills and real PUT /v1/shutdown
+  drains with worker replacement mid-query.
+
+Every query runs under a watchdog thread: a query that neither returns
+nor raises within the budget is recorded as outcome="hang" (the soak's
+acceptance gate requires zero of those).
+
+Entry points: ``run_scenario`` (one seeded scenario) and ``run_chaos``
+(the full soak; ``bench.py --chaos`` wraps it and writes BENCH_r09.json).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from ..connectors.catalog import default_catalog
+from ..execution.distributed_runner import DistributedQueryRunner
+from ..execution.failure_injector import (
+    GET_RESULTS_FAILURE,
+    PROCESS_EXIT,
+    TASK_FAILURE,
+    TASK_OOM,
+    TASK_STALL,
+    FailureInjector,
+)
+from ..runner import Session
+from .oracle import SqliteOracle, assert_same_rows
+
+__all__ = ["QUERY_MIX", "USER_ERROR_SQL", "build_expected",
+           "run_scenario", "run_chaos"]
+
+CATALOG_SPEC = {
+    "factory": "trino_tpu.connectors.catalog:default_catalog",
+    "kwargs": {"scale_factor": 0.01},
+}
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+_TABLES = ["customer", "orders", "lineitem"]
+
+# Sustained mix: scans, multi-key aggregation, filtered join — all
+# checkable against the sqlite oracle with an unordered row compare.
+QUERY_MIX = [
+    "select count(*) from lineitem",
+    "select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus",
+    "select o_orderstatus, count(*), sum(o_totalprice) from orders "
+    "group by o_orderstatus order by o_orderstatus",
+    "select c_mktsegment, count(*), sum(c_acctbal) from customer "
+    "group by c_mktsegment order by c_mktsegment",
+    "select o_orderpriority, count(*) from orders, customer "
+    "where o_custkey = c_custkey and c_mktsegment = 'BUILDING' "
+    "group by o_orderpriority order by o_orderpriority",
+    "select count(*), sum(o_totalprice) from orders "
+    "where o_totalprice > 100000",
+]
+
+# USER-classified error: must fail fast with ZERO retries even while
+# faults are being injected around it.
+USER_ERROR_SQL = \
+    "select o_orderkey / (o_orderkey - o_orderkey) from orders"
+
+# Fault menu per mode.  "none" keeps a healthy baseline inside every
+# scenario; "drain" is a live coordinator-driven drain mid-query.
+_INPROC_FAULTS = ["none", "none", TASK_FAILURE, TASK_STALL, TASK_OOM,
+                  GET_RESULTS_FAILURE, "drain"]
+_PROCESS_FAULTS = _INPROC_FAULTS + [PROCESS_EXIT]
+
+
+def build_expected() -> dict:
+    """Oracle rows for every SQL in QUERY_MIX (computed once per soak —
+    expected rows are a pure function of the sf=0.01 dataset)."""
+    catalog = default_catalog(scale_factor=0.01)
+    conn = catalog.connector("tpch")
+    oracle = SqliteOracle()
+    for t in _TABLES:
+        cols = conn.get_table_schema(t).column_names()
+        batches = []
+        for s in conn.get_splits(t, 2, 1):
+            src = conn.create_page_source(s, cols)
+            while not src.is_finished():
+                b = src.get_next_batch()
+                if b is not None:
+                    batches.append(b)
+        oracle.load_table(t, batches)
+    return {sql: oracle.query(sql) for sql in QUERY_MIX}
+
+
+def _execute_watched(runner, sql: str, timeout_s: float):
+    """Run ``runner.execute(sql)`` under a watchdog.  Returns
+    (rows | None, exception | None, hung: bool, wall_s)."""
+    holder: dict = {}
+
+    def _work():
+        try:
+            holder["rows"] = runner.execute(sql).rows()
+        except BaseException as e:  # noqa: BLE001 - classified by caller
+            holder["exc"] = e
+
+    t0 = time.monotonic()
+    th = threading.Thread(target=_work, daemon=True, name="chaos-query")
+    th.start()
+    th.join(timeout_s)
+    wall = time.monotonic() - t0
+    if th.is_alive():
+        return None, None, True, wall
+    return holder.get("rows"), holder.get("exc"), False, wall
+
+
+def _classify_outcome(sql, rows, exc, hung, retried, expected):
+    if hung:
+        return "hang", "watchdog timeout"
+    if sql == USER_ERROR_SQL:
+        if exc is not None and "DIVISION_BY_ZERO" in str(exc):
+            return "classified_failure", None
+        return "unexpected", f"user error not classified: {exc!r}"
+    if exc is not None:
+        return "unexpected", f"{type(exc).__name__}: {exc}"
+    try:
+        assert_same_rows(rows, expected[sql], ordered=False)
+    except AssertionError as e:
+        return "unexpected", f"oracle mismatch: {e}"
+    return ("ok_after_retry" if retried else "ok"), None
+
+
+def run_scenario(seed: int, mode: str = "inproc", n_queries: int = 8,
+                 expected: Optional[dict] = None,
+                 query_timeout_s: Optional[float] = None) -> dict:
+    """One seeded chaos scenario: a fresh 2-worker runner, ``n_queries``
+    queries from the mix, each with a seeded fault (or none), plus live
+    drains.  Returns {"seed", "mode", "outcomes": [...], counts...}."""
+    if expected is None:
+        expected = build_expected()
+    rng = random.Random(seed)
+    timeout = query_timeout_s or (30.0 if mode == "inproc" else 90.0)
+    inj = FailureInjector()
+    session = Session(node_count=2, retry_policy="QUERY",
+                      failure_injector=inj, retry_initial_delay_s=0.01,
+                      heartbeat_interval_s=0.2, speculation=True,
+                      drain_timeout_s=5.0)
+    if mode == "inproc":
+        runner = DistributedQueryRunner(
+            default_catalog(scale_factor=0.01), worker_count=2,
+            session=session)
+        faults = _INPROC_FAULTS
+    else:
+        from ..execution.remote import ProcessDistributedQueryRunner
+        runner = ProcessDistributedQueryRunner(
+            CATALOG_SPEC, worker_count=2, session=session,
+            env_overrides=_ENV)
+        faults = _PROCESS_FAULTS
+
+    outcomes = []
+    try:
+        for qi in range(n_queries):
+            sql = (USER_ERROR_SQL if rng.random() < 0.12
+                   else rng.choice(QUERY_MIX))
+            fault = rng.choice(faults)
+            task_index = rng.randrange(2)
+            if fault == TASK_STALL:
+                inj.inject(TASK_STALL, fragment_id=None,
+                           task_index=task_index, attempt=0, times=1,
+                           stall_s=round(0.3 + rng.random() * 0.5, 2))
+            elif fault not in ("none", "drain"):
+                inj.inject(fault, fragment_id=None,
+                           task_index=task_index, attempt=0, times=1)
+
+            retries_before = runner.resilience.query_retries
+            if fault == "drain":
+                rows, exc, hung, wall = _run_with_drain(
+                    runner, sql, mode, rng, timeout)
+            else:
+                rows, exc, hung, wall = _execute_watched(
+                    runner, sql, timeout)
+            retried = runner.resilience.query_retries > retries_before
+            outcome, detail = _classify_outcome(
+                sql, rows, exc, hung, retried, expected)
+            outcomes.append({
+                "query": qi, "sql": sql, "fault": fault,
+                "outcome": outcome, "detail": detail,
+                "wall_s": round(wall, 3), "retried": retried,
+            })
+            if outcome == "hang":
+                break  # the runner is wedged; stop the scenario here
+    finally:
+        close = getattr(runner, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+
+    counts: dict = {}
+    for o in outcomes:
+        counts[o["outcome"]] = counts.get(o["outcome"], 0) + 1
+    return {"seed": seed, "mode": mode, "outcomes": outcomes,
+            "counts": counts,
+            "speculative_starts": getattr(runner, "speculative_starts", 0),
+            "speculative_wins": getattr(runner, "speculative_wins", 0)}
+
+
+def _run_with_drain(runner, sql, mode, rng, timeout_s):
+    """Run a query and drain a seeded-random worker mid-flight.  In-proc
+    the drain is logical (stop scheduling; restore afterwards); process
+    mode issues a real PUT /v1/shutdown and replaces the worker."""
+    holder: dict = {}
+
+    def _work():
+        try:
+            holder["rows"] = runner.execute(sql).rows()
+        except BaseException as e:  # noqa: BLE001
+            holder["exc"] = e
+
+    t0 = time.monotonic()
+    th = threading.Thread(target=_work, daemon=True, name="chaos-query")
+    th.start()
+    time.sleep(0.02 + rng.random() * 0.15)
+    if mode == "inproc":
+        victim = f"worker-{rng.randrange(2)}"
+        try:
+            runner.drain_worker(victim)
+            th.join(timeout_s)
+        finally:
+            runner.restore_worker(victim)
+    else:
+        victim = runner.workers[rng.randrange(2)]
+        runner.drain_worker(victim, replace=True)
+        th.join(timeout_s)
+    wall = time.monotonic() - t0
+    if th.is_alive():
+        return None, None, True, wall
+    return holder.get("rows"), holder.get("exc"), False, wall
+
+
+def run_chaos(n_scenarios: int = 25, base_seed: int = 1009,
+              inproc_queries: int = 8, process_queries: int = 4,
+              process_stride: int = 4, verbose: bool = True) -> dict:
+    """The full soak.  Every ``process_stride``-th scenario runs against
+    real worker processes; the rest are in-process.  Returns a summary
+    with per-scenario records and the acceptance booleans."""
+    expected = build_expected()
+    scenarios = []
+    for i in range(n_scenarios):
+        mode = ("process" if process_stride and i % process_stride
+                == process_stride - 1 else "inproc")
+        n_q = process_queries if mode == "process" else inproc_queries
+        t0 = time.monotonic()
+        rec = run_scenario(base_seed + i, mode=mode, n_queries=n_q,
+                           expected=expected)
+        rec["scenario"] = i
+        rec["wall_s"] = round(time.monotonic() - t0, 2)
+        scenarios.append(rec)
+        if verbose:
+            print(f"  chaos scenario {i:2d} seed={base_seed + i} "
+                  f"mode={mode:7s} {rec['counts']} "
+                  f"({rec['wall_s']:.1f}s)", flush=True)
+
+    totals: dict = {}
+    retry_walls = []
+    for rec in scenarios:
+        for k, v in rec["counts"].items():
+            totals[k] = totals.get(k, 0) + v
+        retry_walls += [o["wall_s"] for o in rec["outcomes"]
+                        if o["retried"]]
+    n_queries = sum(len(r["outcomes"]) for r in scenarios)
+    return {
+        "n_scenarios": n_scenarios,
+        "base_seed": base_seed,
+        "n_queries": n_queries,
+        "totals": totals,
+        "hangs": totals.get("hang", 0),
+        "unexpected": totals.get("unexpected", 0),
+        "max_recovery_s": round(max(retry_walls), 3) if retry_walls
+        else 0.0,
+        "all_accounted": (totals.get("hang", 0) == 0
+                          and totals.get("unexpected", 0) == 0),
+        "scenarios": scenarios,
+    }

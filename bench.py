@@ -1052,9 +1052,55 @@ def run_fused_bench() -> None:
     }))
 
 
+def run_profile_bench() -> None:
+    """``--profile``: run Q1 through the engine with the flight recorder on
+    and dump the merged Chrome trace (open in Perfetto / chrome://tracing).
+    BENCH_PROFILE_OUT sets the output path; BENCH_PROFILE_FULL=1 switches
+    to TRINO_TPU_PROFILE=full device-time attribution."""
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    out_path = os.environ.get("BENCH_PROFILE_OUT", "/tmp/trino_tpu_trace.json")
+    _ensure_backend()
+    _enable_compile_cache()
+
+    from trino_tpu.runner import Session, StandaloneQueryRunner
+    from trino_tpu.telemetry import profiler
+
+    prev = None
+    if os.environ.get("BENCH_PROFILE_FULL", "") == "1":
+        prev = profiler.set_level(2)
+    catalog = _stage_memory_tables(sf)
+    runner = StandaloneQueryRunner(
+        catalog, session=Session(default_catalog="memory", splits_per_node=1))
+    runner.execute(Q1, query_id="bench_warm")  # warm compile caches
+    t0 = time.perf_counter()
+    runner.execute(Q1, query_id="bench_profile")
+    wall_s = time.perf_counter() - t0
+    trace = runner.profile("bench_profile")
+    if prev is not None:
+        profiler.set_level(prev)
+    assert trace is not None, "profiler produced no trace"
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    by_cat: dict[str, int] = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "X":
+            by_cat[ev["cat"]] = by_cat.get(ev["cat"], 0) + 1
+    print(json.dumps({
+        "metric": f"profile_sf{sf:g}",
+        "wall_ms": round(wall_s * 1e3, 1),
+        "trace_path": out_path,
+        "events": sum(by_cat.values()),
+        "events_by_cat": by_cat,
+        "full_mode": prev is not None,
+    }))
+
+
 def main() -> None:
     if "--baseline" in sys.argv:
         run_baseline()
+        return
+    if "--profile" in sys.argv:
+        run_profile_bench()
         return
     if "--scan" in sys.argv:
         run_scan_bench()

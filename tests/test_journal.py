@@ -1,0 +1,211 @@
+"""Durable query journal (telemetry/journal.py): rotation bounds, torn-line
+tolerance, the enriched QueryCompletedEvent round-trip, the
+``system.runtime.query_history`` table, journal-seeded admission estimates
+across a (subprocess-simulated) coordinator restart, and the
+tools/lint_journal_schema.py contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution.resource_manager import estimate_peak_memory
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.spi.eventlistener import QueryCompletedEvent
+from trino_tpu.telemetry import journal
+from trino_tpu.telemetry import runtime as rt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_JOURNAL_DIR", str(tmp_path / "journal"))
+    monkeypatch.delenv("TRINO_TPU_JOURNAL", raising=False)
+    journal.reset_for_test()
+    yield
+    journal.reset_for_test()
+
+
+def _completed(qid: str, sql: str = "SELECT 1", peak: int = 0,
+               state: str = "FINISHED", **kw) -> QueryCompletedEvent:
+    return QueryCompletedEvent(qid, sql, state=state, user="test",
+                               peak_memory_bytes=peak, **kw)
+
+
+# ----------------------------------------------------------- rotation bounds
+
+
+def test_rotation_keeps_size_and_file_count_bounded(tmp_path):
+    j = journal.QueryJournal(directory=str(tmp_path / "j"),
+                            max_bytes=2048, max_files=2)
+    for i in range(200):
+        j.query_completed(_completed(f"q_{i}"))
+    files = j.files()
+    assert len(files) <= 3  # current + 2 rotated generations
+    for f in files:
+        # one record of slack: rotation triggers when a write would overflow
+        assert os.path.getsize(f) <= 2048 + 600
+    records = j.read()
+    ids = [r["query_id"] for r in records]
+    assert "q_199" in ids, "newest record must survive"
+    assert "q_0" not in ids, "oldest generation must have been dropped"
+    assert ids == sorted(ids, key=lambda s: int(s.split("_")[1])), \
+        "read() must return records oldest-first"
+
+
+def test_torn_tail_and_garbage_lines_are_skipped(tmp_path):
+    j = journal.QueryJournal(directory=str(tmp_path / "j"))
+    j.query_completed(_completed("q_good"))
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write("not json at all\n")
+        f.write('{"schema": 1, "event": "query_completed", "query_id":')
+    # the process crashed mid-write; the restarted journal must detect the
+    # torn tail and not corrupt its first record by appending onto it
+    j2 = journal.QueryJournal(directory=str(tmp_path / "j"))
+    j2.query_completed(_completed("q_after"))
+    ids = [r["query_id"] for r in j2.read()]
+    assert ids == ["q_good", "q_after"]
+
+
+def test_disabled_journal_returns_none(monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_JOURNAL", "0")
+    journal.reset_for_test()
+    assert journal.get_journal() is None
+    assert journal.history() == []
+
+
+# ------------------------------------------------- event listener round-trip
+
+
+def test_completed_event_enrichment_round_trips(tmp_path):
+    """The PR's QueryCompletedEvent additions — queued_time_ms,
+    resource_group, speculative_wins, error_code — must survive the
+    write/read cycle byte-for-byte."""
+    j = journal.QueryJournal(directory=str(tmp_path / "j"))
+    j.query_completed(_completed(
+        "q_rt", sql="SELECT 2", peak=1 << 20, queued_time_ms=12.5,
+        resource_group="global.etl", speculative_wins=3,
+        wall_ms=99.0, cpu_ms=42.0, output_rows=7, input_rows=100,
+        input_bytes=4096, retry_count=1))
+    j.query_completed(_completed(
+        "q_err", sql="SELECT 1/0", state="FAILED",
+        error="division by zero", error_code="DIVISION_BY_ZERO"))
+    ok, err = j.read(events=("query_completed",))
+    assert ok["queued_time_ms"] == 12.5
+    assert ok["resource_group"] == "global.etl"
+    assert ok["speculative_wins"] == 3
+    assert ok["retry_count"] == 1
+    assert ok["fingerprint"] == rt.fingerprint("SELECT 2")
+    assert ok["schema"] == journal.SCHEMA_VERSION
+    assert err["state"] == "FAILED"
+    assert err["error_code"] == "DIVISION_BY_ZERO"
+
+
+def test_runner_writes_journal_and_classifies_failures():
+    """End to end through the engine: FINISHED and FAILED queries both land
+    in the journal, the failure with its spi/errors.py error code."""
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+    r.execute("select count(*) from tpch.tiny.region")
+    with pytest.raises(Exception):
+        r.execute("select 1 / 0")
+    recs = journal.history()
+    by_state = {rec["state"]: rec for rec in recs}
+    assert "FINISHED" in by_state and "FAILED" in by_state
+    assert by_state["FINISHED"]["output_rows"] == 1
+    assert by_state["FAILED"]["error_code"] == "DIVISION_BY_ZERO"
+    created = journal.get_journal().read(events=("query_created",))
+    assert len(created) == 2
+
+
+# ------------------------------------- restart durability + admission seeding
+
+
+_CHILD = r"""
+import os
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.runner import StandaloneQueryRunner
+from trino_tpu.spi.eventlistener import QueryCompletedEvent
+from trino_tpu.telemetry import journal
+
+r = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+r.execute("select count(*) from tpch.tiny.region",
+          query_id="q_pre_restart")
+# a finished run of the estimator's target plan, with a real peak (CPU runs
+# report no device watermark, so the peak is stamped via the listener path)
+journal.get_journal().query_completed(QueryCompletedEvent(
+    "q_heavy", "select * from big", state="FINISHED",
+    peak_memory_bytes=7 << 20))
+print("CHILD_OK")
+"""
+
+
+def test_restart_preserves_history_and_seeds_admission(tmp_path):
+    """The acceptance scenario: a coordinator process runs queries and
+    dies; the next process (this one) still lists them in
+    system.runtime.query_history, and estimate_peak_memory returns the
+    journal-seeded peak instead of the default."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TRINO_TPU_JOURNAL_DIR=os.environ["TRINO_TPU_JOURNAL_DIR"])
+    out = subprocess.run([sys.executable, "-c", _CHILD], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "CHILD_OK" in out.stdout, out.stderr[-2000:]
+
+    # "restarted coordinator": fresh singleton + seed cache in this process
+    journal.reset_for_test()
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+    rows = r.execute(
+        "select query_id, state, output_rows from "
+        "system.runtime.query_history").rows()
+    assert ("q_pre_restart", "FINISHED", 1) in [tuple(x) for x in rows]
+
+    fp = rt.fingerprint("select * from big")
+    assert all(q.fingerprint != fp for q in rt.queries()), \
+        "estimator must have no in-memory history for this fingerprint"
+    default = 64 << 20
+    assert estimate_peak_memory(fp, default) == 7 << 20
+    assert estimate_peak_memory("fp_unknown", default) == default
+
+
+def test_query_history_table_maps_all_columns(tmp_path):
+    j = journal.get_journal()
+    j.query_completed(_completed(
+        "q_cols", sql="SELECT 3", peak=123, queued_time_ms=1.5,
+        resource_group="global", speculative_wins=2, wall_ms=10.0,
+        output_rows=4, input_rows=40, input_bytes=400))
+    r = StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+    rows = r.execute(
+        "select query_id, fingerprint, peak_memory_bytes, queued_time_ms, "
+        "resource_group, speculative_wins, error_code "
+        "from system.runtime.query_history where query_id = 'q_cols'").rows()
+    assert [tuple(x) for x in rows] == [
+        ("q_cols", rt.fingerprint("SELECT 3"), 123, 1.5, "global", 2, None)]
+
+
+# ------------------------------------------------------------- schema lint
+
+
+def test_journal_schema_lint_passes():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "lint_journal_schema.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_journal_schema_lint_catches_bad_record():
+    from tools.lint_journal_schema import lint_record
+
+    assert lint_record({"schema": journal.SCHEMA_VERSION,
+                        "event": "query_completed", "ts": 1.0,
+                        "query_id": "q"}) == []
+    problems = lint_record({"event": "x", "ts": 1.0, "query_id": "q",
+                            "stats": {"nested": True}})
+    assert any("schema" in p for p in problems)
+    assert any("nested" not in p and "stats" in p for p in problems)
+    assert lint_record({"schema": journal.SCHEMA_VERSION, "event": "x",
+                        "ts": float("nan"), "query_id": "q"})

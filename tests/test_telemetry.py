@@ -280,3 +280,45 @@ def test_query_wall_distribution_records():
     runner.execute("select 2")
     after = tm.QUERY_WALL_SECONDS.snapshot()["count"]
     assert after >= before + 2
+
+
+# -------------------------------------------- cluster-wide metric fold units
+
+
+def test_merge_snapshot_sums_counters_and_folds_distributions():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for r, n in ((r1, 3), (r2, 5)):
+        c = r.counter("trino_widgets_total", "w")
+        c.inc(n)
+        d = r.distribution("trino_lat_seconds", "l", lo=1e-3)
+        for _ in range(n):
+            d.record(0.01)
+    snap = r1.snapshot()
+    tm.merge_snapshot(snap, r2.snapshot())
+    assert snap["trino_widgets_total"]["value"] == 8
+    assert snap["trino_lat_seconds"]["count"] == 8
+    assert abs(snap["trino_lat_seconds"]["sum"] - 0.08) < 1e-9
+    # unknown names are adopted; mismatched bucket layouts are skipped
+    r3 = MetricsRegistry()
+    r3.counter("trino_other_total", "o").inc()
+    d3 = r3.distribution("trino_lat_seconds", "l", lo=1e-1)
+    d3.record(0.5)
+    tm.merge_snapshot(snap, r3.snapshot())
+    assert snap["trino_other_total"]["value"] == 1
+    assert snap["trino_lat_seconds"]["count"] == 8  # skew-safe: skipped
+
+
+def test_render_snapshot_prometheus_matches_live_histogram_shape():
+    r = MetricsRegistry()
+    d = r.distribution("trino_lat_seconds", "latency", lo=1e-3)
+    d.record(0.002)
+    d.record(1e9)  # lands in the +Inf overflow bucket
+    text = tm.render_snapshot_prometheus(r.snapshot())
+    lines = text.splitlines()
+    assert "# TYPE trino_lat_seconds histogram" in lines
+    buckets = [l for l in lines if l.startswith("trino_lat_seconds_bucket")]
+    assert buckets[-1] == 'trino_lat_seconds_bucket{le="+Inf"} 2'
+    assert "trino_lat_seconds_count 2" in lines
+    # cumulative: counts never decrease down the bucket ladder
+    counts = [int(b.rsplit(" ", 1)[1]) for b in buckets]
+    assert counts == sorted(counts)

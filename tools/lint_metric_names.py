@@ -79,24 +79,38 @@ def registrations(root: str) -> dict[str, list[tuple[str, int]]]:
     return sites
 
 
-def run(root: str) -> list[tuple[str, int, str, str]]:
+# metric families the observability plane is contractually expected to
+# expose (PR 11 flight recorder): at least one registration of each must
+# exist, so a refactor can't silently drop the profiler/journal telemetry
+REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_")
+
+
+def run(root: str, require_families: bool = False
+        ) -> list[tuple[str, int, str, str]]:
     findings = []
     for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
         for fn in sorted(files):
             if fn.endswith(".py"):
                 findings.extend(lint_file(os.path.join(dirpath, fn)))
-    for name, sites in sorted(registrations(root).items()):
+    sites_by_name = registrations(root)
+    for name, sites in sorted(sites_by_name.items()):
         if len(sites) > 1:
             for path, lineno in sites[1:]:
                 findings.append((path, lineno, name,
                                  f"duplicate registration (first at "
                                  f"{sites[0][0]}:{sites[0][1]})"))
+    if require_families:
+        for fam in REQUIRED_FAMILIES:
+            if not any(n.startswith(fam) for n in sites_by_name):
+                findings.append(
+                    (os.path.join(root, SCAN_DIR), 0, fam + "*",
+                     "required metric family has no registration site"))
     return findings
 
 
 def main() -> int:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run(root)
+    findings = run(root, require_families=True)
     for path, lineno, name, problem in findings:
         rel = os.path.relpath(path, root)
         print(f"{rel}:{lineno}: {name!r}: {problem}")

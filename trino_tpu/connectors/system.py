@@ -56,6 +56,18 @@ _TABLES = {
         ("running", BIGINT), ("queued", BIGINT),
         ("memory_bytes", BIGINT), ("cpu_usage_s", DOUBLE),
     ]),
+    # durable flight-recorder feed (telemetry/journal.py): completed queries
+    # read back from the on-disk journal, surviving coordinator restarts
+    "runtime.query_history": _schema("runtime.query_history", [
+        ("query_id", VARCHAR), ("state", VARCHAR), ("user", VARCHAR),
+        ("sql", VARCHAR), ("fingerprint", VARCHAR), ("ts", DOUBLE),
+        ("wall_ms", DOUBLE), ("cpu_ms", DOUBLE),
+        ("output_rows", BIGINT), ("input_rows", BIGINT),
+        ("input_bytes", BIGINT), ("retry_count", BIGINT),
+        ("peak_memory_bytes", BIGINT), ("queued_time_ms", DOUBLE),
+        ("resource_group", VARCHAR), ("speculative_wins", BIGINT),
+        ("error", VARCHAR), ("error_code", VARCHAR),
+    ]),
     "runtime.tasks": _schema("runtime.tasks", [
         ("query_id", VARCHAR), ("task_id", VARCHAR), ("fragment", BIGINT),
         ("task_index", BIGINT), ("worker", VARCHAR), ("state", VARCHAR),
@@ -159,6 +171,26 @@ class SystemConnector(Connector):
                  g.hard_concurrency_limit, g.max_queued,
                  g.running, g.queued, g.memory_usage_bytes, g.cpu_usage_s)
                 for g in dispatcher.groups()
+            ]
+        if table == "runtime.query_history":
+            from ..telemetry import journal as tj
+
+            return [
+                (r.get("query_id", ""), r.get("state", ""),
+                 r.get("user", ""), r.get("sql", ""),
+                 r.get("fingerprint", ""), float(r.get("ts", 0.0) or 0.0),
+                 float(r.get("wall_ms", 0.0) or 0.0),
+                 float(r.get("cpu_ms", 0.0) or 0.0),
+                 int(r.get("output_rows", -1) or 0),
+                 int(r.get("input_rows", 0) or 0),
+                 int(r.get("input_bytes", 0) or 0),
+                 int(r.get("retry_count", 0) or 0),
+                 int(r.get("peak_memory_bytes", 0) or 0),
+                 float(r.get("queued_time_ms", 0.0) or 0.0),
+                 r.get("resource_group", ""),
+                 int(r.get("speculative_wins", 0) or 0),
+                 r.get("error"), r.get("error_code"))
+                for r in tj.history()
             ]
         if table == "runtime.tasks":
             return [

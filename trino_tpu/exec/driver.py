@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import Optional, Sequence
 
+from ..telemetry import profiler
 from .operators import Operator
 from .stats import OperatorStats, PipelineStats, QueryStats, ScanIngestStats
 
@@ -41,9 +42,10 @@ class Driver:
         assert operators, "empty pipeline"
         self.operators = list(operators)
         self.stats = stats
+        self._names = [type(op).__name__ for op in self.operators]
         if stats is not None:
             stats.operators.extend(
-                OperatorStats(type(op).__name__) for op in self.operators)
+                OperatorStats(name) for name in self._names)
 
     def _emit(self, i: int, page) -> None:
         """Credit a page moving from operator i to i+1."""
@@ -79,6 +81,13 @@ class Driver:
         n = len(ops)
         timed = self.stats is not None
         st = self.stats.operators if timed else None
+        # profiler: one wall-clock read + one tuple store per successful
+        # page move (no device syncs, no locks).  At TRINO_TPU_PROFILE=full
+        # the produced page is blocked-on first, so the enclosing event
+        # charges true device time instead of async dispatch time.
+        prof = profiler.enabled()
+        prof_full = prof and profiler.is_full()
+        names = self._names
         any_progress = False
         while not ops[-1].is_finished():
             progressed = False
@@ -91,14 +100,24 @@ class Driver:
                     continue
                 if not cur.is_finished() and nxt.needs_input():
                     t0 = time.perf_counter() if timed else 0.0
+                    p0 = time.time() if prof else 0.0
                     page = cur.get_output()
                     if timed:
                         st[i].wall_s += time.perf_counter() - t0
                     if page is not None:
+                        if prof:
+                            if prof_full:
+                                profiler.sync_batch(page)
+                            profiler.event(profiler.OPERATOR, names[i], p0,
+                                           rows=page.num_rows)
                         t0 = time.perf_counter() if timed else 0.0
+                        p0 = time.time() if prof else 0.0
                         nxt.add_input(page)
                         if timed:
                             st[i + 1].wall_s += time.perf_counter() - t0
+                        if prof:
+                            profiler.event(profiler.OPERATOR, names[i + 1],
+                                           p0, rows=page.num_rows)
                         self._emit(i, page)
                         progressed = True
                 if cur.is_finished() and not nxt.input_done:
@@ -114,9 +133,15 @@ class Driver:
                             e for op in ops
                             for e in getattr(op, "pending_errors", ())])
                     t0 = time.perf_counter() if timed else 0.0
+                    p0 = time.time() if prof else 0.0
                     nxt.finish_input()
                     if timed:
                         st[i + 1].wall_s += time.perf_counter() - t0
+                    if prof:
+                        # finish is where blocking operators (agg flush,
+                        # sort, join build seal) do their heavy lifting
+                        profiler.event(profiler.OPERATOR,
+                                       names[i + 1] + ".finish", p0)
                     progressed = True
             if ops[-1].is_finished():
                 break
@@ -175,11 +200,17 @@ def run_pipelines(pipelines: Sequence[Sequence[Operator]],
             time.sleep(2e-4)
 
     def run_group(group, runner) -> None:
+        from ..telemetry import profiler
+
         errors: list[BaseException] = []
         stop = threading.Event()
+        # group threads inherit the spawning task thread's profiler
+        # identity, so their operator events attribute to the right query
+        prof_ctx = profiler.capture_context()
 
         def wrapped(q):
             try:
+                profiler.apply_context(prof_ctx)
                 runner(q, stop)
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)

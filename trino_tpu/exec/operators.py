@@ -241,6 +241,14 @@ class ScanOperator(Operator):
 
     def _stage(self, batch: ColumnBatch) -> ColumnBatch:
         if self.ingest_cfg.stage_device:
+            from ..telemetry import profiler
+
+            if profiler.enabled():
+                t0 = profiler.now()
+                staged = self._stager.stage(batch)
+                profiler.event(profiler.STAGE, "scan.stage", t0,
+                               rows=batch.num_rows, bytes=batch.nbytes)
+                return staged
             return self._stager.stage(batch)
         return batch
 

@@ -32,7 +32,9 @@ class Spiller:
 
     def spill(self, batch: ColumnBatch) -> None:
         from ..execution.serde import write_frame
+        from ..telemetry import profiler
 
+        t0 = profiler.now() if profiler.enabled() else 0.0
         if self._file is None:
             fd, path = tempfile.mkstemp(prefix="trino-tpu-spill-",
                                         suffix=".bin", dir=self._dir)
@@ -42,15 +44,24 @@ class Spiller:
         write_frame(self._file, page)
         self.pages_spilled += 1
         self.bytes_spilled += len(page)
+        if t0:
+            profiler.event(profiler.SPILL, "spill.write", t0,
+                           rows=batch.num_rows, bytes=len(page))
 
     def read_back(self) -> Iterator[ColumnBatch]:
         from ..execution.serde import iter_frames
+        from ..telemetry import profiler
 
         if self._file is None:
             return
         self._file.seek(0)
         for frame in iter_frames(self._file):
-            yield deserialize_batch(frame)
+            t0 = profiler.now() if profiler.enabled() else 0.0
+            b = deserialize_batch(frame)
+            if t0:
+                profiler.event(profiler.SPILL, "spill.read_back", t0,
+                               rows=b.num_rows, bytes=len(frame))
+            yield b
 
     def close(self) -> None:
         if self._file is not None:

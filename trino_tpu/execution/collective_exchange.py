@@ -408,7 +408,14 @@ class CollectiveRepartitionExchange:
             from .task import STALL_TIMEOUT_S
 
             timeout = STALL_TIMEOUT_S
-        if not self._done.wait(timeout):
+        from ..telemetry import profiler
+
+        t0 = profiler.now() if profiler.enabled() else 0.0
+        ok = self._done.wait(timeout)
+        if t0:
+            profiler.event(profiler.EXCHANGE, "collective.take", t0,
+                           stalled=not ok)
+        if not ok:
             raise TrinoError(
                 PAGE_TRANSPORT_TIMEOUT,
                 f"collective exchange stalled after {timeout:.0f}s")

@@ -29,6 +29,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -153,7 +154,9 @@ class DispatchManager:
         # selector(sql, session) -> subgroup name ('' = root)
         self._selector = selector
         self._tracker: dict[str, QueryInfo] = {}
-        self._history: list[str] = []
+        # deque: history eviction is O(1) popleft under the lock (list.pop(0)
+        # shifted the whole buffer on every submit past max_history)
+        self._history: deque[str] = deque()
         self._max_history = max_history
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -190,7 +193,7 @@ class DispatchManager:
             self._tracker[qid] = info
             self._history.append(qid)
             while len(self._history) > self._max_history:
-                self._tracker.pop(self._history.pop(0), None)
+                self._tracker.pop(self._history.popleft(), None)
         fsm.set("WAITING_FOR_RESOURCES")
         t0 = time.monotonic()
         try:

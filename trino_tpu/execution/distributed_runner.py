@@ -85,6 +85,11 @@ class DistributedQueryRunner:
         self.event_listeners = EventListenerManager()
         self.access_control = AccessControlManager()
         self._qids = itertools.count(1)
+        from ..telemetry import journal as _journal
+
+        j = _journal.get_journal()
+        if j is not None:
+            self.event_listeners.add(j)
         # query-level resilience surface (retry_policy=QUERY): cumulative
         # counters + an append-only event log of retries / blacklists /
         # heartbeat transitions / replacements, shared with the process
@@ -140,12 +145,20 @@ class DistributedQueryRunner:
         return self.create_subplan(sql).text()
 
     # --------------------------------------------------------------- execute
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str,
+                query_id: Optional[str] = None) -> QueryResult:
         from ..runner import run_with_query_events
 
         return run_with_query_events(
-            f"dq_{next(self._qids)}", sql, self.session.user,
+            query_id or f"dq_{next(self._qids)}", sql, self.session.user,
             self.event_listeners, self.tracer, lambda: self._execute(sql))
+
+    def profile(self, query_id: str) -> Optional[dict]:
+        """Chrome trace_event JSON of a profiled query's merged
+        coordinator+worker timeline, or None when unknown."""
+        from ..telemetry import profiler
+
+        return profiler.chrome_trace(query_id)
 
     def _execute(self, sql: str) -> QueryResult:
         from ..runner import check_ddl_access
@@ -484,6 +497,12 @@ class DistributedQueryRunner:
             if spec is not None:
                 self.speculative_starts += spec.starts
                 self.speculative_wins += spec.wins
+                if spec.wins:
+                    from ..telemetry import runtime as _rt
+
+                    qrec = _rt.current_record()
+                    if qrec is not None:
+                        qrec.speculative_wins += spec.wins
         kerr = handle.killed_error()
         if errors or hung or kerr is not None:
             for s in stages.values():
@@ -882,6 +901,13 @@ class DistributedQueryRunner:
             query_record.query_id if query_record is not None else "",
             f"f{stage.fragment.id}.t{task_index}", stage.fragment.id,
             task_index, "local")
+        from ..telemetry import profiler
+
+        # task threads are fresh per task: stamp the query/task identity so
+        # every driver/exchange event this thread (and its pipeline group
+        # threads, via run_pipelines context inheritance) records attributes
+        profiler.set_context(trec.query_id, trec.task_id)
+        pt0 = profiler.now()
         t0 = _time.perf_counter()
         pipelines = None
         state = "FINISHED"
@@ -935,6 +961,7 @@ class DistributedQueryRunner:
                     rt.add_input(query_record, ingest.scan_rows,
                                  ingest.scan_bytes)
         tm.TASK_WALL_SECONDS.record(_time.perf_counter() - t0)
+        profiler.event(profiler.TASK, trec.task_id, pt0, state=state)
         if state == "FAILED":
             tm.TASKS_FAILED.inc()
         rt.task_finished(trec, state, error=err)

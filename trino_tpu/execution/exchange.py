@@ -122,6 +122,9 @@ class ExchangeClient:
         """One batch if available anywhere; None if drained-for-now.
         Consuming a page advances the token by one; the NEXT get() at that
         token acks (frees) it — exactly the reference's ack-on-advance."""
+        from ..telemetry import profiler
+
+        t0 = profiler.now() if profiler.enabled() else 0.0
         for s in self._sources:
             buf, token, done = s
             if done:
@@ -130,8 +133,18 @@ class ExchangeClient:
                                               timeout=timeout)
             if pages:
                 s[1] = token + 1
+                if t0:
+                    # serde-wired buffers hand back SerializedPage (no
+                    # num_rows until deserialization downstream)
+                    rows = getattr(pages[0], "num_rows", None)
+                    profiler.event(profiler.EXCHANGE, "exchange.poll", t0,
+                                   rows=rows)
                 return pages[0]
             s[2] = fin
+        # only dry polls that actually blocked are worth a timeline slice —
+        # the 50ms poll loop would otherwise flood the ring with no-ops
+        if t0 and profiler.now() - t0 > 0.010:
+            profiler.event(profiler.EXCHANGE, "exchange.poll", t0, empty=True)
         return None
 
     def is_finished(self) -> bool:

@@ -168,6 +168,15 @@ class HttpExchangeClient:
         from ..telemetry.metrics import observe_exchange
 
         observe_exchange(len(body), count, time.perf_counter() - t0)
+        from ..telemetry import profiler
+
+        if count and profiler.enabled():
+            # one event per non-empty fetch: the wall time covers the
+            # long-poll wait plus page transfer for this source
+            wall = time.perf_counter() - t0
+            profiler.event(profiler.EXCHANGE, "http-exchange.fetch",
+                           profiler.now() - wall, pages=count,
+                           bytes=len(body))
         return count
 
     def poll(self, timeout: float = 0.05) -> Optional[ColumnBatch]:
@@ -661,6 +670,16 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
                         or time.monotonic() > budget:
                     break
                 time.sleep(0.05)
+            prof = st.get("profile") if st else None
+            if prof and rec is not None:
+                # worker rings are keyed by the worker-visible pq{N} id;
+                # re-tag onto the engine query id so the coordinator's
+                # chrome_trace merges both processes into one timeline
+                from ..telemetry import profiler
+
+                profiler.add_remote_events(
+                    rec.query_id, prof,
+                    process_name=f"worker:{remote_task.worker_url}")
             if not d:
                 continue
             sub = Span.from_dict(d)

@@ -443,6 +443,16 @@ def estimate_peak_memory(fingerprint: str, default_bytes: int,
              and q.peak_memory_bytes > 0]
     if peaks:
         return max(peaks[-history:])
+    # no in-memory history (fresh coordinator): the durable query journal
+    # seeds the estimate across restarts (telemetry/journal.py)
+    try:
+        from ..telemetry import journal as tj
+
+        seeded = tj.seeded_peak(fingerprint, history)
+        if seeded > 0:
+            return seeded
+    except Exception:  # noqa: BLE001 — journal trouble never blocks admission
+        pass
     return default_bytes
 
 

@@ -217,6 +217,10 @@ class StreamingSpeculation:
                 self.wins += 1
             tm.SPECULATIVE_WINS.inc()
             self.events.append(("speculative_win", fid, t))
+            from ..telemetry import profiler
+
+            profiler.instant(profiler.SPECULATION,
+                             f"speculative-win[f{fid}.t{t}]")
         if had_twin:
             self.events.append(("speculative_cancelled", fid, t, loser))
 
@@ -256,6 +260,10 @@ class StreamingSpeculation:
             for t, _tr in lagging:
                 tm.SPECULATIVE_STARTS.inc()
                 self.events.append(("speculative_start", st.fid, t))
+                from ..telemetry import profiler
+
+                profiler.instant(profiler.SPECULATION,
+                                 f"speculative-start[f{st.fid}.t{t}]")
                 th = spawn(st.fid, t)
                 if th is not None:
                     out.append(th)

@@ -571,12 +571,21 @@ class FusedStageExec:
             self._count += 1
             run_it = self._count == self.spec.n_tasks
         if run_it:
+            from ..telemetry import profiler
+
+            t0 = profiler.now() if profiler.enabled() else 0.0
             try:
                 with SG.hot_region():
                     self._run_merge()
                 self.stats.merges += 1
             except BaseException as e:  # surfaced to every waiting consumer
                 self._error = e
+            if t0:
+                profiler.event(
+                    profiler.FUSED,
+                    f"fused-merge[f{self.spec.producer_fid}->"
+                    f"f{self.spec.consumer_fid}]", t0,
+                    tasks=self.spec.n_tasks)
             self._done.set()
 
     def abort(self) -> None:
@@ -738,7 +747,16 @@ class FusedStageExec:
             from .task import STALL_TIMEOUT_S
 
             timeout = STALL_TIMEOUT_S
-        if not self._done.wait(timeout):
+        from ..telemetry import profiler
+
+        t0 = profiler.now() if profiler.enabled() else 0.0
+        ok = self._done.wait(timeout)
+        if t0:
+            profiler.event(
+                profiler.EXCHANGE,
+                f"fused-take[f{self.spec.producer_fid}->"
+                f"f{self.spec.consumer_fid}]", t0, stalled=not ok)
+        if not ok:
             raise TrinoError(
                 PAGE_TRANSPORT_TIMEOUT,
                 f"fused stage seam f{self.spec.producer_fid}->"
@@ -771,8 +789,16 @@ class FusedStageSinkOperator(Operator):
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_rows == 0:
             return
+        from ..telemetry import profiler
+
+        t0 = profiler.now() if profiler.enabled() else 0.0
         with SG.hot_region():
             self._accumulate(batch)
+        if t0:
+            profiler.event(
+                profiler.FUSED,
+                f"fused-accumulate[f{self.spec.producer_fid}]", t0,
+                rows=batch.num_rows)
 
     def _accumulate(self, batch: ColumnBatch) -> None:
         spec = self.spec

@@ -84,6 +84,10 @@ class _Task:
         # the coordinator's ClusterMemoryManager can aggregate reservations
         self.query_id: Optional[str] = None
         self.memory = None
+        # flight-recorder ring slice for this task (telemetry/profiler.py),
+        # harvested just before the terminal state and shipped alongside
+        # the span so the coordinator can merge the device timeline
+        self.profile: Optional[list] = None
 
     def status_json(self, include_span: bool = False) -> dict:
         mem = self.memory
@@ -100,6 +104,8 @@ class _Task:
                "pages_out": getattr(self.buffer, "pages_enqueued", 0)}
         if include_span and self.span is not None:
             out["span"] = self.span
+        if include_span and self.profile:
+            out["profile"] = self.profile
         return out
 
 
@@ -201,9 +207,15 @@ class TaskServer:
                 "tasks": len(self.tasks)}).encode())
             return
         if parts == ["v1", "metrics"]:
-            # Prometheus text exposition of the worker-process registry
             from ..telemetry.metrics import REGISTRY
 
+            # ?format=json ships the raw registry snapshot — the structured
+            # form the coordinator's scope=cluster fold merges (Prometheus
+            # text can't be merged without re-parsing)
+            if query.get("format", [""])[0] == "json":
+                h._send(200, json.dumps(REGISTRY.snapshot()).encode())
+                return
+            # Prometheus text exposition of the worker-process registry
             h._send(200, REGISTRY.render_prometheus().encode(),
                     "text/plain; version=0.0.4")
             return
@@ -418,6 +430,12 @@ class TaskServer:
             getattr(desc.get("fragment"), "id", -1),
             desc.get("task_index", -1), worker_addr)
         t0 = _time.perf_counter()
+        # flight recorder: this thread's ring events attribute to the
+        # coordinator-assigned (worker-visible) query id + this task
+        from ..telemetry import profiler
+
+        profiler.set_context(str(desc.get("query_id", "")), t.task_id)
+        pt0 = profiler.now()
         # remote-parented span: the coordinator's traceparent header makes
         # this a local root carrying the query's trace identity; the ctx is
         # entered/exited explicitly so the span can close (and publish to
@@ -593,6 +611,13 @@ class TaskServer:
         try:
             ctx.__exit__(None, None, None)
             t.span = sp.to_dict()  # span visible before terminal state read
+            profiler.event(profiler.TASK, t.task_id, pt0, state=state)
+            # sweep the ring slice for this task (run_pipelines group
+            # threads inherited the context, so their operator events are
+            # included) BEFORE the terminal state so a status read that
+            # observes FINISHED/FAILED always sees the profile too
+            t.profile = profiler.take_task_events(
+                str(desc.get("query_id", "")), t.task_id)
             tm.TASK_WALL_SECONDS.record(_time.perf_counter() - t0)
             if state == "FAILED":
                 tm.TASKS_FAILED.inc()

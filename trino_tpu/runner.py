@@ -286,15 +286,17 @@ def run_with_query_events(qid: str, sql: str, user: str, listeners, tracer,
 
     from .spi.eventlistener import QueryCompletedEvent, QueryCreatedEvent
     from .telemetry import metrics as tm
+    from .telemetry import profiler
     from .telemetry import runtime as rt
 
     listeners.query_created(QueryCreatedEvent(qid, sql, user))
     rec = rt.query_started(qid, sql, user)
     tm.QUERIES_STARTED.inc()
+    prof_ctx = profiler.set_context(qid)
     t0 = _time.perf_counter()
     cpu0 = _time.process_time()
 
-    def _finish(state: str, rows: int, error):
+    def _finish(state: str, rows: int, error, error_code=None):
         wall = (_time.perf_counter() - t0) * 1e3
         cpu = (_time.process_time() - cpu0) * 1e3
         tm.QUERY_WALL_SECONDS.record(wall / 1e3)
@@ -303,17 +305,28 @@ def run_with_query_events(qid: str, sql: str, user: str, listeners, tracer,
         peak = tm.update_device_memory_watermark() or 0
         rt.query_finished(rec, state, wall, cpu, rows, error,
                           peak_memory_bytes=peak)
+        # this process's ring events move into the bounded per-query
+        # profile store before the rings can wrap (worker-process events
+        # arrive separately, via task status JSON)
+        profiler.harvest(qid)
+        profiler.apply_context(prof_ctx)
         listeners.query_completed(QueryCompletedEvent(
             qid, sql, state, user, wall, rows, error,
             cpu_ms=cpu, peak_memory_bytes=peak,
             input_rows=rec.input_rows, input_bytes=rec.input_bytes,
-            retry_count=rec.retry_count))
+            retry_count=rec.retry_count,
+            queued_time_ms=rec.queued_ms,
+            resource_group=rec.resource_group,
+            speculative_wins=rec.speculative_wins,
+            error_code=error_code))
 
     try:
         with tracer.span("trino.query", query_id=qid):
             result = thunk()
     except BaseException as e:
-        _finish("FAILED", -1, str(e))
+        from .spi.errors import classify
+
+        _finish("FAILED", -1, str(e), error_code=classify(e).code.name)
         raise
     rows = result.batch.live_count if result.batch.columns else 0
     _finish("FINISHED", rows, None)
@@ -491,6 +504,11 @@ class StandaloneQueryRunner:
         sysconn = self.catalog._connectors.get("system")
         if sysconn is not None and hasattr(sysconn, "attach"):
             sysconn.attach(self)
+        from .telemetry import journal as _journal
+
+        j = _journal.get_journal()
+        if j is not None:
+            self.event_listeners.add(j)
 
     def create_plan(self, sql: str) -> PlanNode:
         return self._plan_stmt(parse_statement(sql))
@@ -506,10 +524,20 @@ class StandaloneQueryRunner:
     def explain(self, sql: str) -> str:
         return plan_text(self.create_plan(sql))
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str,
+                query_id: Optional[str] = None) -> QueryResult:
+        # an explicit query_id (the HTTP dispatcher passes its own) keeps
+        # one identity across the protocol, the registries and the profile
         return run_with_query_events(
-            f"sq_{next(self._qids)}", sql, self.session.user,
+            query_id or f"sq_{next(self._qids)}", sql, self.session.user,
             self.event_listeners, self.tracer, lambda: self._execute(sql))
+
+    def profile(self, query_id: str) -> Optional[dict]:
+        """Chrome trace_event JSON of a profiled query (telemetry/
+        profiler.py timeline), or None when unknown."""
+        from .telemetry import profiler
+
+        return profiler.chrome_trace(query_id)
 
     def _execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)

@@ -96,7 +96,9 @@ class QueryDispatcher:
             return
         q.state = "RUNNING"
         try:
-            result = self.runner.execute(q.sql)
+            # the protocol query id IS the engine query id, so the flight
+            # recorder's /v1/query/{id}/profile resolves without a mapping
+            result = self.runner.execute(q.sql, query_id=q.id)
             if q.cancelled:
                 # the engine ran to completion (no mid-kernel interruption
                 # yet), but a cancelled query must not deliver results
@@ -210,19 +212,61 @@ class _Handler(BaseHTTPRequestHandler):
         q = self.dispatcher.submit(sql)
         self._send(200, self._query_payload(q, 0))
 
+    def _cluster_metrics(self) -> str:
+        """One Prometheus exposition for the whole cluster: the coordinator
+        registry folded with every live worker's snapshot (counters summed,
+        distributions merged bucket-wise).  A dead worker is skipped — a
+        scrape must never fail because one node is down."""
+        from ..telemetry import metrics as tm
+
+        snaps = []
+        for w in getattr(self.dispatcher.runner, "workers", None) or []:
+            url = getattr(w, "url", None)
+            if not url:
+                continue
+            try:
+                from ..execution.remote import _http
+
+                with _http("GET", f"{url}/v1/metrics?format=json",
+                           timeout=5.0) as resp:
+                    snaps.append(json.loads(resp.read()))
+            except Exception:  # noqa: BLE001
+                continue
+        return tm.render_cluster(snaps)
+
     def do_GET(self):
-        parts = self.path.strip("/").split("/")
+        from urllib.parse import parse_qs, urlsplit
+
+        url = urlsplit(self.path)
+        qs = parse_qs(url.query)
+        parts = [p for p in url.path.split("/") if p]
         if parts == ["v1", "metrics"]:
-            # Prometheus text exposition of the coordinator-process
-            # registry (_send is JSON-only, so write the text inline)
+            # Prometheus text exposition — coordinator-process registry, or
+            # the merged cluster fold with ?scope=cluster (_send is
+            # JSON-only, so write the text inline)
             from ..telemetry.metrics import REGISTRY
 
-            body = REGISTRY.render_prometheus().encode("utf-8")
+            if qs.get("scope", [""])[0] == "cluster":
+                body = self._cluster_metrics().encode("utf-8")
+            else:
+                body = REGISTRY.render_prometheus().encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if len(parts) == 4 and parts[:2] == ["v1", "query"] and \
+                parts[3] == "profile":
+            # flight-recorder timeline as Chrome trace_event JSON
+            from ..telemetry import profiler
+
+            trace = profiler.chrome_trace(parts[2])
+            if trace is None:
+                self._send(404, {"error": {
+                    "message": f"no profile for query {parts[2]}"}})
+            else:
+                self._send(200, trace)
             return
         # /v1/statement/{id}/{token}
         if len(parts) != 4 or parts[:2] != ["v1", "statement"]:

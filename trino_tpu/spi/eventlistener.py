@@ -42,6 +42,14 @@ class QueryCompletedEvent:
     input_rows: int = 0
     input_bytes: int = 0
     retry_count: int = 0
+    # admission + speculation + failure classification (PR 8/PR 9 additions
+    # the journal round-trips): queue wait, the resource group the query
+    # ran under, speculative twins that won its task races, and the
+    # spi/errors.py error-code name for FAILED queries
+    queued_time_ms: float = 0.0
+    resource_group: str = ""
+    speculative_wins: int = 0
+    error_code: Optional[str] = None
     end_time: float = field(default_factory=time.time)
 
 

@@ -1,0 +1,276 @@
+"""Durable query journal: rotating JSONL of query lifecycle events.
+
+The persistence half of the flight recorder (the timeline half is
+telemetry/profiler.py): a ``QueryJournal`` is an ``EventListener`` plugin
+that appends one JSON line per QueryCreated/QueryCompleted event — the
+full QueryStats rollup, plan fingerprint, resource group and error code —
+to a size-bounded, rotating journal file.  The reference persists the same
+record through its event-listener plugins (mysql-event-listener /
+http-event-listener); here the sink is local disk because the journal is
+also *read back*:
+
+- ``system.runtime.query_history`` (connectors/system.py) scans it through
+  the ordinary Connector SPI, so pre-restart queries stay SQL-queryable;
+- ``resource_manager.estimate_peak_memory`` falls back to
+  :func:`seeded_peak` when the in-process registry has no history for a
+  plan fingerprint, turning the PR 8 admission estimator from per-process
+  folklore into memory that survives coordinator restarts.
+
+Knobs: ``TRINO_TPU_JOURNAL_DIR`` (location; default a per-uid tempdir),
+``TRINO_TPU_JOURNAL_MAX_BYTES`` (rotate threshold per file, default 4 MiB),
+``TRINO_TPU_JOURNAL_FILES`` (rotated generations kept, default 3),
+``TRINO_TPU_JOURNAL=0`` (disable).  Every record carries a versioned
+``schema`` field; tools/lint_journal_schema.py enforces the contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Optional
+
+from ..spi.eventlistener import (
+    EventListener,
+    QueryCompletedEvent,
+    QueryCreatedEvent,
+)
+
+__all__ = [
+    "SCHEMA_VERSION", "REQUIRED_FIELDS", "QueryJournal", "default_dir",
+    "journal_enabled", "get_journal", "history", "seeded_peak",
+    "sample_records", "reset_for_test",
+]
+
+SCHEMA_VERSION = 1
+# every journal record, of any event type, carries at least these
+REQUIRED_FIELDS = ("schema", "event", "ts", "query_id")
+
+_FILE = "query_journal.jsonl"
+
+
+def default_dir() -> str:
+    try:
+        uid = os.getuid()
+    except AttributeError:  # non-POSIX
+        uid = 0
+    return os.path.join(tempfile.gettempdir(), f"trino-tpu-journal-{uid}")
+
+
+def journal_enabled() -> bool:
+    return os.environ.get("TRINO_TPU_JOURNAL", "1").strip().lower() \
+        not in ("0", "off", "false", "no")
+
+
+def _record_from_created(ev: QueryCreatedEvent) -> dict:
+    return {
+        "schema": SCHEMA_VERSION,
+        "event": "query_created",
+        "ts": ev.create_time,
+        "query_id": ev.query_id,
+        "sql": ev.sql,
+        "user": ev.user,
+    }
+
+
+def _record_from_completed(ev: QueryCompletedEvent) -> dict:
+    from . import runtime as rt
+
+    return {
+        "schema": SCHEMA_VERSION,
+        "event": "query_completed",
+        "ts": ev.end_time,
+        "query_id": ev.query_id,
+        "sql": ev.sql,
+        "user": ev.user,
+        "state": ev.state,
+        "wall_ms": float(ev.wall_ms),
+        "cpu_ms": float(ev.cpu_ms),
+        "output_rows": int(ev.output_rows),
+        "input_rows": int(ev.input_rows),
+        "input_bytes": int(ev.input_bytes),
+        "retry_count": int(ev.retry_count),
+        "peak_memory_bytes": int(ev.peak_memory_bytes),
+        "queued_time_ms": float(ev.queued_time_ms),
+        "resource_group": ev.resource_group,
+        "speculative_wins": int(ev.speculative_wins),
+        "error": None if ev.error is None else str(ev.error),
+        "error_code": ev.error_code,
+        "fingerprint": rt.fingerprint(ev.sql),
+    }
+
+
+def sample_records() -> list[dict]:
+    """One representative record per event type the journal can emit —
+    the corpus tools/lint_journal_schema.py validates."""
+    created = _record_from_created(
+        QueryCreatedEvent("q_sample", "SELECT 1", user="lint"))
+    ok = _record_from_completed(QueryCompletedEvent(
+        "q_sample", "SELECT 1", state="FINISHED", user="lint",
+        wall_ms=1.5, output_rows=1, cpu_ms=0.5, peak_memory_bytes=1 << 20,
+        input_rows=10, input_bytes=100, retry_count=0, queued_time_ms=0.25,
+        resource_group="global.adhoc", speculative_wins=1))
+    failed = _record_from_completed(QueryCompletedEvent(
+        "q_sample2", "SELECT 1/0", state="FAILED", user="lint",
+        error="DIVISION_BY_ZERO: division by zero",
+        error_code="DIVISION_BY_ZERO"))
+    return [created, ok, failed]
+
+
+class QueryJournal(EventListener):
+    """Size-bounded rotating JSONL sink + reader."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_bytes: Optional[int] = None,
+                 max_files: Optional[int] = None):
+        self.directory = directory or \
+            os.environ.get("TRINO_TPU_JOURNAL_DIR") or default_dir()
+        self.max_bytes = max_bytes if max_bytes is not None else int(
+            os.environ.get("TRINO_TPU_JOURNAL_MAX_BYTES", str(4 << 20)))
+        self.max_files = max_files if max_files is not None else int(
+            os.environ.get("TRINO_TPU_JOURNAL_FILES", "3"))
+        self.path = os.path.join(self.directory, _FILE)
+        self._lock = threading.Lock()
+        # first write of this process checks for a torn tail line (a crash
+        # mid-write); appending straight onto it would corrupt the next
+        # record too, so a newline is inserted first
+        self._tail_checked = False
+
+    # ------------------------------------------------------- listener side
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._write(_record_from_created(event))
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        self._write(_record_from_completed(event))
+
+    def _write(self, rec: dict) -> None:
+        from . import metrics as tm
+
+        line = json.dumps(rec, default=str) + "\n"
+        data = line.encode("utf-8")
+        with self._lock:
+            os.makedirs(self.directory, exist_ok=True)
+            try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                size = 0
+            if not self._tail_checked:
+                self._tail_checked = True
+                if size:
+                    with open(self.path, "rb") as f:
+                        f.seek(-1, os.SEEK_END)
+                        if f.read(1) != b"\n":
+                            line = "\n" + line
+                            data = line.encode("utf-8")
+            if size and size + len(data) > self.max_bytes:
+                self._rotate()
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+        tm.JOURNAL_RECORDS.inc()
+        tm.JOURNAL_BYTES.inc(len(data))
+
+    def _rotate(self) -> None:
+        """journal.jsonl -> .1 -> .2 ... -> .max_files (dropped)."""
+        from . import metrics as tm
+
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        tm.JOURNAL_ROTATIONS.inc()
+
+    # --------------------------------------------------------- reader side
+    def files(self) -> list[str]:
+        """Journal files oldest-first (rotated generations then current)."""
+        out = [f"{self.path}.{i}" for i in range(self.max_files, 0, -1)]
+        out.append(self.path)
+        return [p for p in out if os.path.exists(p)]
+
+    def read(self, events: Optional[tuple] = None) -> list[dict]:
+        """Every parseable record, oldest-first; a torn tail line (crash
+        mid-write) is skipped, not fatal — the journal must be readable
+        after any kill."""
+        out: list[dict] = []
+        for path in self.files():
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if not isinstance(rec, dict) or "schema" not in rec:
+                            continue
+                        if events is None or rec.get("event") in events:
+                            out.append(rec)
+            except OSError:
+                continue
+        return out
+
+
+# ------------------------------------------------------------ process state
+
+_SINGLETON: Optional[QueryJournal] = None
+_SINGLETON_LOCK = threading.Lock()
+_SEED_CACHE: Optional[dict] = None
+
+
+def get_journal() -> Optional[QueryJournal]:
+    """The process-wide journal (one file lock, shared by every runner in
+    the process), or None when disabled via TRINO_TPU_JOURNAL=0."""
+    global _SINGLETON
+    if not journal_enabled():
+        return None
+    with _SINGLETON_LOCK:
+        if _SINGLETON is None:
+            _SINGLETON = QueryJournal()
+        return _SINGLETON
+
+
+def history() -> list[dict]:
+    """Completed-query records from disk, oldest-first — the
+    system.runtime.query_history feed (always re-read: restarts and other
+    coordinator processes may have appended)."""
+    j = get_journal()
+    if j is None:
+        return []
+    return j.read(events=("query_completed",))
+
+
+def seeded_peak(fp: str, history_len: int = 5) -> int:
+    """Journal-seeded admission estimate: max peak of the fingerprint's
+    most recent FINISHED runs on disk, 0 when unknown.  Loaded once per
+    process — live runs land in telemetry/runtime.py and take precedence,
+    so the cache only has to cover the cold-start window."""
+    global _SEED_CACHE
+    if _SEED_CACHE is None:
+        cache: dict[str, list[int]] = {}
+        for rec in history():
+            if rec.get("state") != "FINISHED":
+                continue
+            peak = int(rec.get("peak_memory_bytes", 0) or 0)
+            if peak <= 0:
+                continue
+            cache.setdefault(rec.get("fingerprint", ""), []).append(peak)
+        _SEED_CACHE = cache
+    peaks = _SEED_CACHE.get(fp)
+    if not peaks:
+        return 0
+    return max(peaks[-history_len:])
+
+
+def reset_for_test() -> None:
+    """Forget the singleton and the seed cache — the in-process stand-in
+    for a coordinator restart (env changes take effect on next use)."""
+    global _SINGLETON, _SEED_CACHE
+    with _SINGLETON_LOCK:
+        _SINGLETON = None
+    _SEED_CACHE = None

@@ -472,6 +472,21 @@ CLUSTER_MEMORY_FREE = REGISTRY.gauge(
     "trino_cluster_memory_free_bytes",
     "cluster memory capacity minus reservations (0 when uncapped)")
 
+# query flight recorder (telemetry/profiler.py + telemetry/journal.py)
+PROFILE_EVENTS = REGISTRY.counter("trino_profile_events_total",
+                                  "timeline profiler events harvested "
+                                  "into query profiles")
+PROFILE_DROPPED = REGISTRY.counter("trino_profile_dropped_total",
+                                   "profiler ring slots overwritten before "
+                                   "harvest (raise TRINO_TPU_PROFILE_RING "
+                                   "if nonzero)")
+JOURNAL_RECORDS = REGISTRY.counter("trino_journal_records_total",
+                                   "query journal records written")
+JOURNAL_BYTES = REGISTRY.counter("trino_journal_bytes_total",
+                                 "query journal bytes written")
+JOURNAL_ROTATIONS = REGISTRY.counter("trino_journal_rotations_total",
+                                     "query journal file rotations")
+
 
 # ------------------------------------------------------------ observe hooks
 def resource_group_gauges(path: str):
@@ -581,3 +596,78 @@ def update_device_memory_watermark() -> Optional[int]:
     DEVICE_MEMORY_IN_USE.set(in_use)
     DEVICE_MEMORY_PEAK.set(peak)
     return peak
+
+
+# ------------------------------------------------------- cluster-wide fold
+# Worker processes keep their own registries; /v1/metrics?scope=cluster on
+# the coordinator fetches each worker's snapshot() JSON and folds it into
+# one exposition: counters and gauges summed, Distributions bucket-merged
+# (the merge Distribution.merge already defines for same-bounds layouts).
+
+
+def merge_snapshot(into: dict, other: dict) -> None:
+    """Fold one registry ``snapshot()`` dict into another, in place.
+    Unknown names are adopted; a distribution with mismatched bucket
+    layout is skipped (a version-skewed worker must not corrupt the
+    roll-up)."""
+    import copy as _copy
+
+    for name, s in other.items():
+        m = into.get(name)
+        if m is None:
+            into[name] = _copy.deepcopy(s)
+            continue
+        if m.get("kind") != s.get("kind"):
+            continue
+        if s["kind"] == "distribution":
+            if m.get("bounds") != s.get("bounds"):
+                continue
+            if s["count"]:
+                m["min"] = min(m["min"], s["min"]) if m["count"] else s["min"]
+                m["max"] = max(m["max"], s["max"]) if m["count"] else s["max"]
+            m["count"] += s["count"]
+            m["sum"] += s["sum"]
+            m["buckets"] = [a + b
+                            for a, b in zip(m["buckets"], s["buckets"])]
+        else:
+            m["value"] += s["value"]
+
+
+def render_snapshot_prometheus(snap: dict, helps: Optional[dict] = None
+                               ) -> str:
+    """Prometheus text exposition of a (possibly merged) snapshot dict —
+    the same format ``MetricsRegistry.render_prometheus`` emits from live
+    metric objects."""
+    helps = helps or {}
+    lines: list[str] = []
+    for name in sorted(snap):
+        s = snap[name]
+        kind = s.get("kind")
+        if kind == "distribution":
+            lines.append(f"# HELP {name} {helps.get(name, '')}")
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for le, n in zip(s["bounds"], s["buckets"]):
+                cum += n
+                lines.append(f'{name}_bucket{{le="{_fmt(le)}"}} {cum}')
+            cum += s["buckets"][-1]
+            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{name}_sum {_fmt(s['sum'])}")
+            lines.append(f"{name}_count {s['count']}")
+        elif kind in ("counter", "gauge"):
+            lines.append(f"# HELP {name} {helps.get(name, '')}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {_fmt(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def render_cluster(remote_snapshots: list[dict]) -> str:
+    """The coordinator's scope=cluster view: local registry snapshot plus
+    every reachable worker's, folded and rendered as one exposition."""
+    merged = REGISTRY.snapshot()
+    for snap in remote_snapshots:
+        if isinstance(snap, dict):
+            merge_snapshot(merged, snap)
+    with REGISTRY._lock:
+        helps = {n: m.help for n, m in REGISTRY._metrics.items()}
+    return render_snapshot_prometheus(merged, helps)

@@ -41,7 +41,7 @@ class QueryRecord:
                  "end_time", "wall_ms", "cpu_ms", "output_rows", "error",
                  "input_rows", "input_bytes", "retry_count",
                  "peak_memory_bytes", "fingerprint", "queued_ms",
-                 "resource_group", "_lock")
+                 "resource_group", "speculative_wins", "_lock")
 
     def __init__(self, query_id: str, sql: str, user: str):
         self.query_id = query_id
@@ -61,6 +61,7 @@ class QueryRecord:
         self.fingerprint = fingerprint(sql)
         self.queued_ms = 0.0
         self.resource_group = ""
+        self.speculative_wins = 0
         self._lock = threading.Lock()
 
 

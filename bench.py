@@ -26,7 +26,9 @@ failing (subprocess device probe, same pattern as __graft_entry__).
 Subcommands: ``--scan`` (ingest microbench), ``--ndv [1e3,1e4,...]``
 (TRINO_TPU_HASH_IMPL hash-vs-sort NDV-ladder bake-off, see run_ndv_bench),
 ``--qps`` (two-tenant weighted-fair sustained-load harness + OOM drill,
-see run_qps_bench; BENCH_QPS_DURATION/BENCH_QPS_SF/BENCH_QPS_CLIENTS).
+see run_qps_bench; BENCH_QPS_DURATION/BENCH_QPS_SF/BENCH_QPS_CLIENTS),
+``--warm`` (cache-plane cold/warm/warm-after-mutation ladder, see
+run_warm_bench; BENCH_WARM_SF/BENCH_WARM_REPS).
 """
 
 from __future__ import annotations
@@ -230,6 +232,24 @@ def _pct(sorted_vals: list, q: float) -> float:
     return sorted_vals[i]
 
 
+def _result_cache_off(fn):
+    """The qps/OOM legs measure *execution* — admission, fair scheduling,
+    the cluster kill path.  A served cached result would skip the very
+    machinery under measurement, so the result tier is pinned off for the
+    duration of the leg (plan/executable tiers stay on: their hits still
+    execute)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from trino_tpu.caching import result_cache
+
+        with result_cache.disabled():
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+@_result_cache_off
 def run_qps_sustained(duration_s: float, catalog, clients_per_group: int = 5,
                       sql: str = None) -> dict:
     """The sustained-load leg: closed-loop clients per tenant hammer the
@@ -302,6 +322,7 @@ def run_qps_sustained(duration_s: float, catalog, clients_per_group: int = 5,
     return out
 
 
+@_result_cache_off
 def run_qps_oom_drill(catalog, capacity_bytes: int = 64 << 20,
                       pressure_bytes: int = 256 << 20,
                       timeout_s: float = 60.0) -> dict:
@@ -553,6 +574,109 @@ def run_chaos_bench(write: bool = True) -> dict:
     if write:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_r09.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
+def run_warm_bench(write: bool = True) -> dict:
+    """``bench.py --warm``: the repeated-traffic cold/warm ladder for the
+    three-tier cache plane (trino_tpu/caching/).  Three legs over the Q1+Q3
+    mix:
+
+    - **cold** — empty caches: parse -> plan -> optimize -> compile -> run.
+    - **warm** — identical texts re-submitted: Tier A skips planning, Tier C
+      serves the versioned result without touching the executors.
+      Acceptance: warm p50 at least 10x under cold p50.
+    - **warm-after-mutation** — INSERT into lineitem, re-run: every row set
+      must match a cache-disabled oracle run (the result tier re-validates
+      on the bumped table version; a stale serve fails the bench).
+
+    Env knobs: BENCH_WARM_SF (default 0.05), BENCH_WARM_REPS (default 20).
+    Writes BENCH_r12.json with p50/p99 per leg and per-tier hit rates."""
+    sf = float(os.environ.get("BENCH_WARM_SF", "0.05"))
+    reps = int(os.environ.get("BENCH_WARM_REPS", "20"))
+    _ensure_backend()
+    _enable_compile_cache()
+
+    import jax
+
+    from trino_tpu import caching
+    from trino_tpu.runner import Session, StandaloneQueryRunner
+
+    caching.reset_for_test()
+    catalog = _stage_memory_tables(sf)
+    runner = StandaloneQueryRunner(
+        catalog, session=Session(default_catalog="memory", splits_per_node=1))
+
+    def timed(sql: str):
+        t0 = time.perf_counter()
+        r = runner.execute(sql)
+        for c in r.batch.columns:  # force any device work to finish
+            jax.block_until_ready(c.data)
+        return (time.perf_counter() - t0) * 1e3, r
+
+    def oracle_rows(sql: str):
+        """The same query with Tier A/C disabled — the staleness oracle."""
+        os.environ["TRINO_TPU_PLAN_CACHE"] = "0"
+        os.environ["TRINO_TPU_RESULT_CACHE"] = "0"
+        try:
+            return runner.execute(sql).rows()
+        finally:
+            del os.environ["TRINO_TPU_PLAN_CACHE"]
+            del os.environ["TRINO_TPU_RESULT_CACHE"]
+
+    # leg 1 — cold: first submission of each text
+    cold_ms = {name: round(timed(sql)[0], 2) for name, sql in QUERIES.items()}
+
+    # leg 2 — warm: identical texts, reps times each
+    warm_samples: list[float] = []
+    warm_rows: dict[str, list] = {}
+    for _ in range(reps):
+        for name, sql in QUERIES.items():
+            ms, r = timed(sql)
+            warm_samples.append(ms)
+            warm_rows[name] = r.rows()
+    stale = any(warm_rows[name] != oracle_rows(sql)
+                for name, sql in QUERIES.items())
+
+    # leg 3 — mutation: bump lineitem (Q1 and Q3 both scan it), re-run
+    runner.execute("insert into lineitem select * from lineitem "
+                   "where l_orderkey = 1")
+    post_ms: dict[str, float] = {}
+    for name, sql in QUERIES.items():
+        ms, r = timed(sql)
+        post_ms[name] = round(ms, 2)
+        if r.rows() != oracle_rows(sql):
+            stale = True
+
+    tiers = {}
+    for row in caching.cache_rows():
+        total = row["hits"] + row["misses"]
+        tiers[row["name"]] = dict(
+            row, hit_rate=round(row["hits"] / total, 3) if total else 0.0)
+
+    warm_samples.sort()
+    cold_sorted = sorted(cold_ms.values())
+    cold_p50 = _pct(cold_sorted, 0.5)
+    warm_p50 = _pct(warm_samples, 0.5)
+    speedup = cold_p50 / warm_p50 if warm_p50 else 0.0
+    result = {
+        "metric": f"warm_path_speedup_p50_sf{sf:g}",
+        "value": round(speedup, 1),
+        "unit": "cold p50 / warm p50 wall (target >= 10x, no stale serve)",
+        "pass_10x": speedup >= 10.0,
+        "stale_serve": stale,
+        "cold_ms": cold_ms,
+        "warm_p50_ms": round(warm_p50, 3),
+        "warm_p99_ms": round(_pct(warm_samples, 0.99), 3),
+        "warm_after_mutation_ms": post_ms,
+        "tiers": tiers,
+    }
+    print(json.dumps(result))
+    if write:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r12.json"), "w") as f:
             json.dump(result, f, indent=1)
             f.write("\n")
     return result
@@ -1116,6 +1240,9 @@ def main() -> None:
         return
     if "--chaos" in sys.argv:
         run_chaos_bench()
+        return
+    if "--warm" in sys.argv:
+        run_warm_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

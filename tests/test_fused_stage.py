@@ -24,6 +24,14 @@ from trino_tpu.testing.oracle import SqliteOracle, assert_same_rows
 
 TABLES = ["customer", "orders", "lineitem"]
 
+
+@pytest.fixture(autouse=True)
+def _no_result_cache(monkeypatch):
+    # these tests introspect execution internals (_fused_edges, SyncGuard
+    # deltas) on repeated statements — a served cached result would skip
+    # the very path under test
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
+
 AGG_SQL = """
 select l_returnflag, l_linestatus,
        sum(l_quantity), sum(l_extendedprice), min(l_quantity),

@@ -25,7 +25,10 @@ from lineitem group by l_returnflag, l_linestatus
 
 
 @pytest.fixture(autouse=True)
-def _fresh_profiler():
+def _fresh_profiler(monkeypatch):
+    # profiler tests assert on execution timelines of repeated statements —
+    # a served cached result would produce an empty timeline
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
     prev = profiler.set_level(1)
     profiler.reset_for_test()
     yield

@@ -29,6 +29,14 @@ from trino_tpu.spi.errors import (
 from trino_tpu.spi.session import GroupSelector
 
 
+@pytest.fixture(autouse=True)
+def _no_result_cache(monkeypatch):
+    # this file measures admission/memory/kill machinery on repeated
+    # statements (e.g. a 2000-iteration OOM pressure loop) — a served
+    # cached result registers no memory handle and would starve the killer
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
+
+
 class FakeClock:
     def __init__(self):
         self.t = 0.0

@@ -40,24 +40,40 @@ SCAN_DIR = "trino_tpu"
 PRAGMA = "metric-ok"
 
 
+def _logical_lines(path: str):
+    """(lineno, line) pairs, with a registration call split across the
+    black-style line break — ``REGISTRY.counter(`` then the name on the
+    next line — rejoined so the per-line regex still sees it."""
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.rstrip().endswith("(") and i + 1 < len(lines):
+            yield i + 1, line.rstrip() + lines[i + 1].lstrip()
+            i += 2
+            continue
+        yield i + 1, line
+        i += 1
+
+
 def lint_file(path: str) -> list[tuple[str, int, str, str]]:
     """-> [(path, lineno, metric_name, problem)] for one file."""
     findings = []
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            if PRAGMA in line:
-                continue
-            for m in REGISTRATION.finditer(line):
-                kind, name = m.group("kind"), m.group("name")
-                if not LEGAL.match(name):
-                    findings.append((path, lineno, name,
-                                     "illegal Prometheus metric name"))
-                elif not name.startswith(PREFIX):
-                    findings.append((path, lineno, name,
-                                     f"missing mandatory {PREFIX!r} prefix"))
-                elif kind == "counter" and not name.endswith("_total"):
-                    findings.append((path, lineno, name,
-                                     "counter name must end in '_total'"))
+    for lineno, line in _logical_lines(path):
+        if PRAGMA in line:
+            continue
+        for m in REGISTRATION.finditer(line):
+            kind, name = m.group("kind"), m.group("name")
+            if not LEGAL.match(name):
+                findings.append((path, lineno, name,
+                                 "illegal Prometheus metric name"))
+            elif not name.startswith(PREFIX):
+                findings.append((path, lineno, name,
+                                 f"missing mandatory {PREFIX!r} prefix"))
+            elif kind == "counter" and not name.endswith("_total"):
+                findings.append((path, lineno, name,
+                                 "counter name must end in '_total'"))
     return findings
 
 
@@ -69,20 +85,20 @@ def registrations(root: str) -> dict[str, list[tuple[str, int]]]:
             if not fn.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if PRAGMA in line:
-                        continue
-                    for m in REGISTRATION.finditer(line):
-                        sites.setdefault(m.group("name"), []).append(
-                            (path, lineno))
+            for lineno, line in _logical_lines(path):
+                if PRAGMA in line:
+                    continue
+                for m in REGISTRATION.finditer(line):
+                    sites.setdefault(m.group("name"), []).append(
+                        (path, lineno))
     return sites
 
 
 # metric families the observability plane is contractually expected to
-# expose (PR 11 flight recorder): at least one registration of each must
-# exist, so a refactor can't silently drop the profiler/journal telemetry
-REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_")
+# expose (PR 11 flight recorder, PR 12 cache plane): at least one
+# registration of each must exist, so a refactor can't silently drop the
+# profiler/journal/cache telemetry
+REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_")
 
 
 def run(root: str, require_families: bool = False

@@ -1,0 +1,45 @@
+"""The three-tier cache plane for repeated dashboard-style traffic.
+
+- **Tier A** — :mod:`.plan_cache`: fingerprinted logical plans; a hit
+  skips parse → analyze → plan → optimize.
+- **Tier B** — :mod:`.executable_cache`: the bounded/observable registry
+  behind every jitted-program memo, plus JAX's on-disk compilation cache
+  and the boot-time warm journal.
+- **Tier C** — :mod:`.result_cache`: table-version-keyed query results
+  with connector invalidation.
+
+Each tier has an independent ``TRINO_TPU_{PLAN,EXEC,RESULT}_CACHE=0``
+kill switch that restores bit-for-bit legacy behavior.  This module only
+adds the cross-tier observability roll-up consumed by
+``system.runtime.caches`` and ``GET /v1/caches``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["cache_rows", "reset_for_test"]
+
+
+def cache_rows(per_exec_cache: bool = False) -> list[dict]:
+    """Per-tier stats rows: plan, exec (aggregated — or one row per
+    registered cache with ``per_exec_cache``), result.  Dict shape matches
+    the ``system.runtime.caches`` schema."""
+    from . import executable_cache, plan_cache, result_cache
+
+    rows = [plan_cache.stats()]
+    if per_exec_cache:
+        rows.extend(executable_cache.registry_stats())
+    else:
+        rows.append(executable_cache.aggregate_stats())
+    rows.append(result_cache.stats())
+    return rows
+
+
+def reset_for_test() -> None:
+    """Clear every tier's entries and stats (exec registry keeps its
+    registered caches, drops their contents)."""
+    from . import executable_cache, plan_cache, result_cache
+
+    plan_cache.reset_for_test()
+    result_cache.reset_for_test()
+    executable_cache.clear_all()
+    executable_cache.reset_warm_state_for_test()

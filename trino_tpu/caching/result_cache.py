@@ -1,0 +1,212 @@
+"""Tier C: the versioned result cache.
+
+Identical reads against unchanged tables re-executed end to end before
+this tier.  Now every connector exposes a ``data_version(table)`` token
+(spi/connector.py): the memory and file connectors bump it on every
+INSERT / CTAS / DELETE / TRUNCATE / DROP (and on transaction rollback),
+the TPC-H generator is immutable per scale factor, and the system
+connector returns ``None`` — volatile tables are never cached.  A result
+entry is keyed by the plan-cache key prefix (statement text ⊕ session ⊕
+env knobs ⊕ catalog instance) ⊕ the **sorted table-version vector** of
+every table the plan scans.  Any mutation of an input table changes its
+token, so the old entry can never be served again — correctness does not
+depend on eviction racing the write (the write additionally drops
+matching entries eagerly via :func:`invalidate_table`, which is what the
+``invalidations`` counter measures).
+
+The store is size-bounded (``TRINO_TPU_RESULT_CACHE_BYTES``, default
+64 MiB) with LRU eviction; a single result larger than a quarter of the
+budget is not admitted (one giant scan must not wipe the dashboard
+working set).  ``TRINO_TPU_RESULT_CACHE=0`` (checked per lookup) gives
+bit-for-bit legacy behavior.
+
+The materialized-view staleness contract (connectors/catalog.py
+``Catalog.mv_is_stale``) is re-expressed on the same tokens: an MV is
+stale exactly when some base table's current version differs from the
+vector captured at refresh time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+__all__ = [
+    "enabled", "disabled", "capacity_bytes", "version_vector", "result_key",
+    "lookup", "store", "invalidate_table", "result_nbytes", "stats",
+    "reset_for_test",
+]
+
+
+def enabled() -> bool:
+    return os.environ.get("TRINO_TPU_RESULT_CACHE", "1").strip().lower() \
+        not in ("0", "off", "false", "no")
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scope with the result tier off — for harnesses that measure
+    *execution* (fault injection, OOM drills, sync accounting, profiler
+    timelines): a served cached result would skip the very machinery under
+    measurement."""
+    old = os.environ.get("TRINO_TPU_RESULT_CACHE")
+    os.environ["TRINO_TPU_RESULT_CACHE"] = "0"
+    try:
+        yield
+    finally:
+        if old is None:
+            del os.environ["TRINO_TPU_RESULT_CACHE"]
+        else:
+            os.environ["TRINO_TPU_RESULT_CACHE"] = old
+
+
+def capacity_bytes() -> int:
+    return int(os.environ.get("TRINO_TPU_RESULT_CACHE_BYTES",
+                              str(64 << 20)))
+
+
+_LOCK = threading.Lock()
+# key -> (result, nbytes, tables)
+_ENTRIES: OrderedDict = OrderedDict()
+_BYTES = 0
+_HITS = 0
+_MISSES = 0
+_EVICTIONS = 0
+_INVALIDATIONS = 0
+
+
+def version_vector(tables: tuple, catalog) -> Optional[tuple]:
+    """Sorted (catalog, table, version-token) vector for the scanned table
+    set, or None when any table is unversioned (→ uncacheable read)."""
+    out = []
+    for cat_name, table in tables:
+        try:
+            conn = catalog.connector(cat_name)
+            v = conn.data_version(table)
+        except Exception:  # noqa: BLE001 — a vanished table is a miss
+            return None
+        if v is None:
+            return None
+        out.append((cat_name, table, str(v)))
+    return tuple(sorted(out))
+
+
+def result_key(entry, versions: Optional[tuple]) -> Optional[tuple]:
+    """Compose the full Tier-C key, or None when this read is uncacheable
+    (unversioned input, or a plan the plan cache flagged — table
+    functions / writers)."""
+    if versions is None or not getattr(entry, "cacheable_result", False):
+        return None
+    return (entry.result_key_base, versions)
+
+
+def result_nbytes(result) -> int:
+    """Host/device byte footprint of a QueryResult's batch."""
+    total = 0
+    for col in result.batch.columns:
+        for arr in (col.data, col.valid, col.dictionary):
+            if arr is None:
+                continue
+            total += int(getattr(arr, "nbytes", 0) or 0)
+    live = result.batch.live
+    if live is not None:
+        total += int(getattr(live, "nbytes", 0) or 0)
+    return total
+
+
+def lookup(key: Optional[tuple]):
+    global _HITS, _MISSES
+    if key is None or not enabled():
+        return None
+    from ..telemetry import metrics as tm
+
+    with _LOCK:
+        hit = _ENTRIES.get(key)
+        if hit is not None:
+            _ENTRIES.move_to_end(key)
+            _HITS += 1
+        else:
+            _MISSES += 1
+    if hit is None:
+        tm.CACHE_RESULT_MISSES.inc()
+        return None
+    tm.CACHE_RESULT_HITS.inc()
+    from ..telemetry import profiler
+
+    if profiler.enabled():
+        profiler.instant("cache", "result_hit", rows=hit[0].batch.num_rows)
+    return hit[0]
+
+
+def store(key: Optional[tuple], result, tables: tuple) -> bool:
+    global _BYTES, _EVICTIONS
+    if key is None or not enabled():
+        return False
+    nbytes = result_nbytes(result)
+    cap = capacity_bytes()
+    if nbytes > cap // 4:
+        return False
+    from ..telemetry import metrics as tm
+
+    with _LOCK:
+        old = _ENTRIES.pop(key, None)
+        if old is not None:
+            _BYTES -= old[1]
+        _ENTRIES[key] = (result, nbytes, tables)
+        _BYTES += nbytes
+        while _BYTES > cap and _ENTRIES:
+            _, (_r, nb, _t) = _ENTRIES.popitem(last=False)
+            _BYTES -= nb
+            _EVICTIONS += 1
+            tm.CACHE_RESULT_EVICTIONS.inc()
+        tm.CACHE_RESULT_ENTRIES.set(len(_ENTRIES))
+        tm.CACHE_RESULT_BYTES.set(_BYTES)
+    return True
+
+
+def invalidate_table(catalog_name: str, table: str) -> int:
+    """Eagerly drop every entry that read (catalog_name, table).  The
+    version vector already guarantees such entries can never be served;
+    this frees their bytes at mutation time instead of waiting for LRU
+    pressure.  Called by connectors on writes; cheap — the store holds at
+    most a few hundred dashboard-sized entries."""
+    global _BYTES, _INVALIDATIONS
+    if not enabled():
+        return 0
+    from ..telemetry import metrics as tm
+
+    dropped = 0
+    with _LOCK:
+        doomed = [k for k, (_r, _nb, tables) in _ENTRIES.items()
+                  if any(c == catalog_name and t == table
+                         for c, t in tables)]
+        for k in doomed:
+            _r, nb, _t = _ENTRIES.pop(k)
+            _BYTES -= nb
+            dropped += 1
+        if dropped:
+            _INVALIDATIONS += dropped
+            tm.CACHE_RESULT_INVALIDATIONS.inc(dropped)
+            tm.CACHE_RESULT_ENTRIES.set(len(_ENTRIES))
+            tm.CACHE_RESULT_BYTES.set(_BYTES)
+    return dropped
+
+
+def stats() -> dict:
+    with _LOCK:
+        return {
+            "tier": "result", "name": "result", "entries": len(_ENTRIES),
+            "bytes": _BYTES, "hits": _HITS, "misses": _MISSES,
+            "evictions": _EVICTIONS, "invalidations": _INVALIDATIONS,
+        }
+
+
+def reset_for_test() -> None:
+    global _BYTES, _HITS, _MISSES, _EVICTIONS, _INVALIDATIONS
+    with _LOCK:
+        _ENTRIES.clear()
+        _BYTES = 0
+        _HITS = _MISSES = _EVICTIONS = _INVALIDATIONS = 0

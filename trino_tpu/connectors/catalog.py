@@ -7,6 +7,8 @@ session references one default catalog; qualified names pick others.
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Optional
 
 from ..spi.connector import Connector, TableSchema
@@ -17,19 +19,37 @@ __all__ = ["Catalog", "default_catalog"]
 class ViewDefinition:
     """A stored view: the defining query AST, plus (for materialized views)
     the backing table holding the last refresh (reference:
-    spi/connector/ConnectorViewDefinition + MaterializedViewDefinition)."""
+    spi/connector/ConnectorViewDefinition + MaterializedViewDefinition).
 
-    __slots__ = ("query", "materialized", "backing")
+    ``base_versions`` is the connector data_version vector of the base
+    tables captured at refresh time — the same tokens the result cache
+    keys on — so staleness is a pure token comparison
+    (:meth:`Catalog.mv_is_stale`), no data inspection."""
 
-    def __init__(self, query, materialized: bool = False, backing=None):
+    __slots__ = ("query", "materialized", "backing", "base_versions")
+
+    def __init__(self, query, materialized: bool = False, backing=None,
+                 base_versions=None):
         self.query = query
         self.materialized = materialized
         self.backing = backing  # (catalog, table) of the refresh target
+        self.base_versions = base_versions
+
+
+_instance_ids = itertools.count(1)
+_instance_lock = threading.Lock()
 
 
 class Catalog:
     def __init__(self):
         self._connectors: dict[str, Connector] = {}
+        # caching-plane identity: instance_id partitions the process-global
+        # plan/result caches between catalogs (tests build many runners per
+        # process); generation bumps on DDL/ANALYZE so schema or stats
+        # changes invalidate every cached plan against this catalog
+        with _instance_lock:
+            self.instance_id = next(_instance_ids)
+        self.generation = 0
         # CREATE FUNCTION registry: name -> (params, return_type, body AST)
         # (reference: metadata/GlobalFunctionCatalog for SQL routines)
         self.sql_functions: dict[str, tuple] = {}
@@ -43,6 +63,34 @@ class Catalog:
 
     def register(self, name: str, connector: Connector) -> None:
         self._connectors[name] = connector
+        self.bump_generation()
+
+    def bump_generation(self) -> None:
+        """Schema/stats changed (DDL, ANALYZE, connector registration):
+        cached plans built against the old catalog state must miss."""
+        self.generation += 1
+
+    def table_versions(self, tables) -> Optional[tuple]:
+        """Sorted (catalog, table, version) vector for a (catalog, table)
+        iterable; None when any table is unversioned or unresolvable —
+        the caching plane's shared currency."""
+        from ..caching import result_cache
+
+        return result_cache.version_vector(tuple(tables), self)
+
+    def mv_is_stale(self, name: str) -> bool:
+        """A materialized view is stale when some base table's current
+        data_version differs from the vector captured at refresh.  Views
+        never refreshed, or with unversioned bases, report stale (the
+        conservative answer)."""
+        view = self.views.get(name)
+        if view is None or not view.materialized:
+            raise KeyError(f"no such materialized view: {name}")
+        if view.backing is None or view.base_versions is None:
+            return True
+        current = self.table_versions(
+            [(c, t) for c, t, _v in view.base_versions])
+        return current != view.base_versions
 
     def connector(self, name: str) -> Connector:
         if name not in self._connectors:

@@ -159,6 +159,23 @@ class FileConnector(Connector):
             return TableStatistics()
         return TableStatistics(row_count=float(meta.get("rows", 0)))
 
+    def data_version(self, table: str):
+        """On-disk content signature: the page-file list (names embed
+        pid + a monotonic sink sequence, so they are never reused) plus
+        the row count.  Equal signature ⇒ equal bytes on disk, across
+        drop/recreate cycles and across processes."""
+        try:
+            with open(self._meta_path(table)) as f:
+                meta = json.load(f)
+        except FileNotFoundError:
+            raise KeyError(f"file: no such table {table!r}")
+        return f"{meta.get('rows', 0)}:{','.join(meta.get('pages', []))}"
+
+    def _invalidate(self, table: str) -> None:
+        from ..caching import result_cache
+
+        result_cache.invalidate_table(self.name, table)
+
     def create_table(self, schema: TableSchema) -> None:
         d = self._dir(schema.name)
         if os.path.exists(self._meta_path(schema.name)):
@@ -171,9 +188,11 @@ class FileConnector(Connector):
                 "rows": 0,
                 "pages": [],
             }, f)
+        self._invalidate(schema.name)
 
     def drop_table(self, table: str) -> None:
         shutil.rmtree(self._dir(table), ignore_errors=True)
+        self._invalidate(table)
 
     # ---- scan -----------------------------------------------------------
     def get_splits(self, table: str, splits_per_node: int,
@@ -211,3 +230,4 @@ class FileConnector(Connector):
                 meta["rows"] += rows
             with open(self._meta_path(table), "w") as f:
                 json.dump(meta, f)
+        self._invalidate(table)

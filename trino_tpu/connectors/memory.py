@@ -117,6 +117,26 @@ class MemoryConnector(Connector):
         self._pinned_rows: dict[str, int] = {}
         # observability: batches skipped by TupleDomain min/max pruning
         self.batches_pruned = 0
+        # data_version tokens: drawn from one instance-wide monotonic
+        # counter so a drop/recreate cycle can never reissue an old token
+        # (a reset-to-zero per-table counter would let a result cached
+        # against the ORIGINAL table at v0 be served for the NEW one)
+        self._versions: dict[str, int] = {}
+        self._next_version = 0
+
+    def _bump_version(self, table: str) -> None:
+        # callers hold self._lock
+        self._next_version += 1
+        self._versions[table] = self._next_version
+        from ..caching import result_cache
+
+        result_cache.invalidate_table(self.name, table)
+
+    def data_version(self, table: str):
+        with self._lock:
+            if table not in self._schemas:
+                raise KeyError(f"memory: no such table {table!r}")
+            return self._versions.get(table, 0)
 
     def list_tables(self) -> list[str]:
         with self._lock:
@@ -150,6 +170,7 @@ class MemoryConnector(Connector):
                     raise KeyError(f"memory: no such table {table!r}")
                 self._data[table] = []
                 self._pinned_rows.pop(table, None)
+                self._bump_version(table)
             return f"truncated {table}"
 
         def pin_table(table: str) -> str:
@@ -164,12 +185,17 @@ class MemoryConnector(Connector):
                 raise ValueError(f"memory: table {schema.name!r} already exists")
             self._schemas[schema.name] = schema
             self._data[schema.name] = []
+            self._bump_version(schema.name)
 
     def drop_table(self, table: str) -> None:
         with self._lock:
             self._schemas.pop(table, None)
             self._data.pop(table, None)
             self._pinned_rows.pop(table, None)
+            self._versions.pop(table, None)
+            from ..caching import result_cache
+
+            result_cache.invalidate_table(self.name, table)
 
     def get_splits(self, table: str, splits_per_node: int, node_count: int) -> list[Split]:
         with self._lock:
@@ -206,6 +232,7 @@ class MemoryConnector(Connector):
                 if table in self._pinned_rows:
                     self._pinned_rows[table] += sum(
                         b.live_count for b in staged)
+            self._bump_version(table)
 
     # ---- transactions ----------------------------------------------------
     def begin_transaction(self):
@@ -232,6 +259,7 @@ class MemoryConnector(Connector):
                     self._schemas.pop(t, None)
                     self._data.pop(t, None)
                     self._pinned_rows.pop(t, None)
+                    self._versions.pop(t, None)
             for t, n in handle["lengths"].items():
                 if t in self._data and len(self._data[t]) > n:
                     removed = self._data[t][n:]
@@ -239,6 +267,7 @@ class MemoryConnector(Connector):
                     if t in self._pinned_rows:
                         self._pinned_rows[t] -= sum(
                             b.live_count for b in removed)
+                    self._bump_version(t)
 
     def pin_to_device(self, table: str) -> None:
         """Make a table device-resident: batches become bucket-padded jax

@@ -81,6 +81,13 @@ _TABLES = {
     "metrics.counters": _schema("metrics.counters", [
         ("name", VARCHAR), ("kind", VARCHAR), ("value", DOUBLE),
     ]),
+    # three-tier cache plane (caching/): one row per plan/result tier and
+    # per registered executable memo
+    "runtime.caches": _schema("runtime.caches", [
+        ("tier", VARCHAR), ("name", VARCHAR), ("entries", BIGINT),
+        ("bytes", BIGINT), ("hits", BIGINT), ("misses", BIGINT),
+        ("evictions", BIGINT), ("invalidations", BIGINT),
+    ]),
 }
 
 
@@ -200,6 +207,15 @@ class SystemConnector(Connector):
             ]
         if table == "runtime.workers":
             return self._worker_rows()
+        if table == "runtime.caches":
+            from .. import caching
+
+            return [
+                (r["tier"], r["name"], int(r["entries"]), int(r["bytes"]),
+                 int(r["hits"]), int(r["misses"]), int(r["evictions"]),
+                 int(r["invalidations"]))
+                for r in caching.cache_rows(per_exec_cache=True)
+            ]
         if table == "metrics.counters":
             out = []
             for name, snap in metrics.REGISTRY.snapshot().items():

@@ -274,6 +274,14 @@ class TpchConnector(Connector):
         self._vector_decode = os.environ.get(
             "TRINO_TPU_TPCH_VECTOR_DECODE", "1") != "0"
 
+    def data_version(self, table: str):
+        """Generated data is a pure function of the scale factor: a
+        constant token makes repeated TPC-H reads result-cacheable
+        forever within one configuration."""
+        if table not in _TABLES:
+            raise KeyError(f"tpch: no such table {table!r}")
+        return f"sf={self.sf}"
+
     # ---- sizes ----------------------------------------------------------
     def row_count(self, table: str) -> int:
         if table in ("region", "nation"):

@@ -26,7 +26,7 @@ Total blocking host interaction per probe batch: one scalar sync.
 from __future__ import annotations
 
 import threading
-from functools import lru_cache
+from ..caching.executable_cache import jit_memo
 from typing import Optional, Sequence
 
 import jax
@@ -162,7 +162,7 @@ def _hash_planes(h):
     return planes, h32
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._hash_index_fn")
 def _hash_index_fn(S: int, n: int, interpret: bool):
     from ..ops import pallas_kernels as PK
 
@@ -184,7 +184,7 @@ def _hash_index_fn(S: int, n: int, interpret: bool):
     return fn
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._build_fn")
 def _build_fn(num_keys: int, has_valid: tuple, has_live: bool,
               want_range: bool = False):
     @jax.jit
@@ -248,7 +248,7 @@ def _build_fn(num_keys: int, has_valid: tuple, has_live: bool,
     return fn
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._dense_build_fn")
 def _dense_build_fn(size: int, has_valid: bool, has_live: bool, lo: int):
     """Scatter live build rows into dense[key - lo] (one scatter; -1 =
     empty slot).  Exactness needs no verify: direct addressing cannot
@@ -403,7 +403,7 @@ def _probe_hash(num_keys: int, has_valid: tuple, has_remap: tuple,
     return h, live
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._ranges_fn")
 def _ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
                has_remap: tuple):
     @jax.jit
@@ -423,7 +423,7 @@ def _ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
     return fn
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._hash_ranges_fn")
 def _hash_ranges_fn(num_keys: int, has_valid: tuple, has_live: bool,
                     has_remap: tuple, S: int, interpret: bool):
     from ..ops import pallas_kernels as PK
@@ -872,7 +872,7 @@ def run_pairs(table: DeviceJoinTable, lo, counts, total,
 #       matched-build scatter evaluate on the narrow lanes.
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._uranges_fn")
 def _uranges_fn(num_keys: int, has_pvalid: tuple, has_remap: tuple,
                 has_live: bool):
     @jax.jit
@@ -920,7 +920,7 @@ def _uranges_fn(num_keys: int, has_pvalid: tuple, has_remap: tuple,
     return fn
 
 
-@lru_cache(maxsize=None)
+@jit_memo("join._dense_uranges_fn")
 def _dense_uranges_fn(size: int, lo: int, has_pvalid: bool, has_remap: bool,
                       has_live: bool):
     """Program A over a direct-address build: ONE gather per probe row —

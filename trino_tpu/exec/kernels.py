@@ -25,7 +25,7 @@ spec / Trino GroupByHash behavior); equi-join keys never match on NULL.
 from __future__ import annotations
 
 import os
-from functools import lru_cache
+from ..caching.executable_cache import jit_memo
 from typing import Optional, Sequence
 
 import jax
@@ -58,7 +58,7 @@ def bucket(n: int, minimum: int = 8) -> int:
     return c
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._searchsorted_method")
 def _searchsorted_method(shape: tuple) -> str:
     n_needles = 1
     for s in shape:
@@ -74,7 +74,7 @@ def searchsorted(a, v, side: str = "left"):
     needle counts keep 'scan' (sorting the haystack for 8 needles wastes a
     full pass).  The method pick is memoized per needle SHAPE: this runs on
     every trace of every jitted program, so the per-call product over the
-    dims is hoisted into an lru_cache keyed like the jit cache itself."""
+    dims is hoisted into a registry memo keyed like the jit cache itself."""
     method = (_searchsorted_method(tuple(v.shape))
               if hasattr(v, "shape") else "scan")
     return jnp.searchsorted(a, v, side=side, method=method)
@@ -104,7 +104,7 @@ def _neq(a, b):
 # grouped aggregation: sort -> boundary-detect -> segment reduce
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._group_ids_fn")
 def _group_ids_fn(num_keys: int, has_valid: tuple[bool, ...], has_live: bool):
     n_valid = sum(has_valid)
 
@@ -306,7 +306,7 @@ def hash_row_gids(keys: Sequence[tuple], live=None,
     return row_gid, count
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._hash_finish_fn")
 def _hash_finish_fn():
     @jax.jit
     def fn(row_gid):
@@ -398,7 +398,7 @@ def _decode_codes(r, sizes, slots, strides, has_valid):
     return keys_out
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._small_agg_fn")
 def _small_agg_fn(spec: tuple, num_keys: int, has_valid: tuple,
                   has_live: bool, sizes: tuple):
     """Small-group aggregation with NO sort and NO gather: the group id is
@@ -542,7 +542,7 @@ def small_grouped_aggregate(key_cols, live, aggs: Sequence[tuple]):
     return results, presence, keys_out, total
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._group_ids_codes_fn")
 def _group_ids_codes_fn(num_keys: int, has_valid: tuple, has_live: bool,
                         sizes: tuple):
     """Fast path for group keys that are ALL small dictionary codes (the
@@ -665,7 +665,7 @@ def _sentinel(fn: str, dtype) -> object:
     return _SENTINELS[fn][k](dtype)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._reduce_fn")
 def _reduce_fn(spec: tuple, cap: int):
     """spec: tuple of (fn, data_idx, valid_idx, dtype_str, distinct, pre)
     per aggregate; data_idx/valid_idx index the DEDUPED flat input arrays
@@ -851,7 +851,7 @@ def _reduce_fn(spec: tuple, cap: int):
     return fn
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._finalize_fn")
 def _finalize_fn(plan: tuple):
     """One compiled program for aggregation finalization (avg division,
     variance combine, output casts) over the tiny per-group arrays — the
@@ -1105,7 +1105,7 @@ def grouped_reduce(
     return results
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._keys_out_fn")
 def _keys_out_fn(has_valid: tuple, cap: int):
     @jax.jit
     def fn(perm, gid, *flat):
@@ -1195,7 +1195,7 @@ def _sort_columns(keys: Sequence[tuple], xp):
     return sort_cols
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._device_sort_fn")
 def _device_sort_fn(num_keys: int, key_meta: tuple, col_has_valid: tuple,
                     has_live: bool, out_n: Optional[int]):
     """One jitted program: lexsort + gather every payload column (+ live).
@@ -1406,7 +1406,7 @@ def build_join_table(keys: Sequence[tuple], num_rows: Optional[int] = None) -> J
     return JoinTable(sh, perm, datas, has_null, n)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._probe_ranges_fn")
 def _probe_ranges_fn():
     @jax.jit
     def fn(sorted_hash, probe_hash):
@@ -1420,7 +1420,7 @@ def _probe_ranges_fn():
 _PAIR_PAD = 4  # speculative expand headroom over bucket(n_probe)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._expand_fn")
 def _expand_fn(cap: int):
     """Expansion kernel sized to a power-of-two bucket ``cap`` >= total so
     varying per-batch match counts reuse a handful of compiled programs;
@@ -1524,7 +1524,7 @@ def probe_join_table(
 # partitioning (shuffle producer — PagePartitioner.partitionPage equivalent)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._domain_fn")
 def _domain_fn(has_valid: bool, has_live: bool, dict_len: int):
     """Build-key domain for dynamic filtering, all on device: returns
     (valid_count, non-NaN count, min, max, presence-per-dictionary-code).
@@ -1580,7 +1580,7 @@ def _device_domain(data, valid, live, dict_len: int):
     return _domain_fn(valid is not None, live is not None, dict_len)(*flat)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("kernels._compact_fn")
 def _compact_fn(n_cols: int, valid_flags: tuple, has_live_out: bool, cap: int):
     """Gather live rows to the front and slice to ``cap`` lanes (one stable
     bool sort + gathers, all on device)."""

@@ -17,14 +17,15 @@ program per (window spec, shape bucket):
   scans (min/max) — O(n) work, no per-partition loop;
 - navigation functions (lag/lead/first/last/nth_value) are clamped gathers.
 
-Everything is fixed-shape; the only host interaction is the lru_cache keyed
-compile lookup.
+Everything is fixed-shape; the only host interaction is the registry-memo
+(caching/executable_cache.py) compile lookup.
 """
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Optional, Sequence
+
+from ..caching.executable_cache import jit_memo
 
 import jax
 import jax.numpy as jnp
@@ -123,7 +124,7 @@ def _prefix_upto(x, part_start_idx):
     return upto
 
 
-@lru_cache(maxsize=None)
+@jit_memo("window._window_program")
 def _window_program(
     n_part: int,
     part_valid: tuple[bool, ...],

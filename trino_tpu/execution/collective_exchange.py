@@ -20,7 +20,7 @@ FIXED_HASH_DISTRIBUTION) per SURVEY §2.4's collective mapping.
 from __future__ import annotations
 
 import threading
-from functools import lru_cache
+from ..caching.executable_cache import jit_memo
 from typing import Optional, Sequence
 
 import jax
@@ -56,7 +56,7 @@ def collectives_available(n_tasks: int) -> bool:
         return False
 
 
-@lru_cache(maxsize=None)
+@jit_memo("collective._shuffle_program")
 def _shuffle_program(n_dev: int, n_cols: int, dtypes: tuple,
                      valid_flags: tuple, key_idx: tuple, cap: int):
     """One jitted shard_map: route rows of the local [cap] block to owner
@@ -125,7 +125,7 @@ def _shuffle_program(n_dev: int, n_cols: int, dtypes: tuple,
     ))
 
 
-@lru_cache(maxsize=None)
+@jit_memo("collective._sort_by_dest_program")
 def _sort_by_dest_program(n_dev: int, n_cols: int, valid_flags: tuple,
                           key_idx: tuple, cap: int):
     """Tiled path, stage 1: per device, route rows to owners by key hash and
@@ -182,7 +182,7 @@ def _sort_by_dest_program(n_dev: int, n_cols: int, valid_flags: tuple,
     ))
 
 
-@lru_cache(maxsize=None)
+@jit_memo("collective._tiled_all_to_all_program")
 def _tiled_all_to_all_program(n_dev: int, n_cols: int, valid_flags: tuple,
                               cap: int, tile: int):
     """Tiled path, stage 2: pack each destination's dest-sorted run into a

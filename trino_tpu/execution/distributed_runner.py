@@ -161,8 +161,36 @@ class DistributedQueryRunner:
         return profiler.chrome_trace(query_id)
 
     def _execute(self, sql: str) -> QueryResult:
-        from ..runner import check_ddl_access
+        from ..caching import plan_cache, result_cache
+        from ..runner import check_ddl_access, check_select_access
 
+        # Tier A fast path (see runner.py): a hit skips parse → analyze →
+        # plan → optimize → add_exchanges; only statements that reached
+        # _plan_stmt were ever stored, so non-SELECT texts always miss
+        entry = plan_cache.lookup(sql, self.session, self.catalog,
+                                  flavor="fragmented")
+        if entry is not None:
+            check_select_access(entry.plan, self.access_control,
+                                self.session.user)
+            versions = result_cache.version_vector(entry.tables,
+                                                   self.catalog)
+            key = result_cache.result_key(entry, versions)
+            cached = result_cache.lookup(key)
+            if cached is not None:
+                return cached
+
+            def run_cached(fsm):
+                fsm.set("PLANNING")
+                subplan = fragment_plan(plan_cache.clone(entry.plan))
+                fsm.set("STARTING")
+                fsm.set("RUNNING")
+                out = self._execute_subplan(subplan, None)
+                fsm.set("FINISHING")
+                return out
+
+            out = self.dispatcher.submit(sql, self.session, run_cached)
+            result_cache.store(key, out, entry.tables)
+            return out
         stmt = parse_statement(sql)
         from .transaction import handle_transaction_stmt
 
@@ -202,16 +230,30 @@ class DistributedQueryRunner:
         if ddl is not None:
             return ddl
 
+        store_ctx = {}
+
         def run(fsm):
             fsm.set("PLANNING")
-            subplan = fragment_plan(self._plan_stmt(stmt))
+            plan = self._plan_stmt(stmt)
+            new_entry = plan_cache.store(sql, self.session, self.catalog,
+                                         plan, flavor="fragmented")
+            # version vector read BEFORE execution (see runner.py: a
+            # racing mutation strands the entry, never serves stale)
+            store_ctx["key"] = result_cache.result_key(
+                new_entry,
+                result_cache.version_vector(new_entry.tables, self.catalog))
+            store_ctx["tables"] = new_entry.tables
+            subplan = fragment_plan(plan)
             fsm.set("STARTING")
             fsm.set("RUNNING")
             out = self._execute_subplan(subplan, None)
             fsm.set("FINISHING")
             return out
 
-        return self.dispatcher.submit(sql, self.session, run)
+        out = self.dispatcher.submit(sql, self.session, run)
+        if store_ctx.get("key") is not None:
+            result_cache.store(store_ctx["key"], out, store_ctx["tables"])
+        return out
 
     def _execute_subplan(self, subplan: SubPlan,
                          stats_sink: Optional[list]) -> QueryResult:

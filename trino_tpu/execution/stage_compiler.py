@@ -40,8 +40,9 @@ import os
 import threading
 import warnings
 from dataclasses import dataclass, field
-from functools import lru_cache
 from typing import Optional, Sequence
+
+from ..caching.executable_cache import jit_memo, register_external
 
 import jax
 import jax.numpy as jnp
@@ -217,7 +218,23 @@ def plan_fused_stages(fragments, session, task_counts: dict,
 
 _ACCUM_CACHE: dict = {}
 _ACCUM_LOCK = threading.Lock()
+_ACCUM_STATS = {"hits": 0, "misses": 0, "evictions": 0}
 _TRACE_SIGS: set = set()  # (program id, bucket signature) — compile counting
+
+
+def _accum_cache_stats() -> dict:
+    """system.runtime.caches row for the id()-keyed accumulate memo — it
+    cannot live in the registry proper (keys are object identities, not
+    replayable values) but must show up in the observability plane."""
+    with _ACCUM_LOCK:
+        return {"tier": "exec", "name": "stage._accumulate_program",
+                "entries": len(_ACCUM_CACHE), "bytes": 0,
+                "hits": _ACCUM_STATS["hits"],
+                "misses": _ACCUM_STATS["misses"],
+                "evictions": _ACCUM_STATS["evictions"], "invalidations": 0}
+
+
+register_external("stage._accumulate_program", _accum_cache_stats)
 
 
 class _AccumulateProgram:
@@ -383,7 +400,7 @@ class _AccumulateProgram:
         }
 
 
-@lru_cache(maxsize=256)
+@jit_memo("stage._ingest_program", maxsize=256)
 def _ingest_program(n_out: int, miss_valid: tuple, has_live: bool):
     """ONE jitted pad-to-bucket program per pad pattern (jax's own cache
     keys the raw input shapes): pads every column to the power-of-two
@@ -420,9 +437,12 @@ def _accumulate_program(spec: FusedStageSpec, in_types,
     with _ACCUM_LOCK:
         hit = _ACCUM_CACHE.get(key)
         if hit is not None:
+            _ACCUM_STATS["hits"] += 1
             return hit[0]
+        _ACCUM_STATS["misses"] += 1
         if len(_ACCUM_CACHE) >= 256:
             _ACCUM_CACHE.pop(next(iter(_ACCUM_CACHE)))
+            _ACCUM_STATS["evictions"] += 1
     prog = _AccumulateProgram(spec, in_types, in_dicts)
     with _ACCUM_LOCK:
         # dict refs held in the value keep the id()-keyed entries stable
@@ -434,7 +454,7 @@ def _accumulate_program(spec: FusedStageSpec, in_types,
 # the seam merge program: route -> all_to_all -> FINAL combine -> finalize
 
 
-@lru_cache(maxsize=None)
+@jit_memo("stage._merge_program")
 def _merge_program(n_dev: int, cap: int, key_dtypes: tuple, dict_flags: tuple,
                    state_sig: tuple, final_sig: tuple, table_buckets: tuple):
     """One jitted shard_map over the stage mesh: remap state key codes into

@@ -60,6 +60,9 @@ class TransactionManager:
         for cat, handle in txn.connector_handles.items():
             self.catalog.connector(cat).rollback_transaction(handle)
         txn.connector_handles.clear()
+        # rollback can undo CREATE TABLE/CTAS: plans cached since BEGIN may
+        # reference tables that no longer exist — force a replan
+        self.catalog.bump_generation()
 
 
 def handle_transaction_stmt(stmt, session, catalog) -> Optional[object]:

@@ -653,6 +653,17 @@ def main(argv=None) -> None:
         print("TRINO_TPU_TEST_BOOT_FAIL: injected boot failure",
               file=sys.stderr, flush=True)
         sys.exit(3)
+    # Tier B persistence: point XLA at the on-disk compile cache and replay
+    # the warm-key journal so the hottest shape buckets have live wrappers
+    # (whose first invocation loads from disk, not a cold compile) before
+    # the first task arrives
+    from ..caching import executable_cache
+
+    executable_cache.init_compile_cache()
+    try:
+        executable_cache.warm_at_boot()
+    except Exception:  # noqa: BLE001 — warming must never block boot
+        pass
     server = TaskServer(args.port)
     print(f"LISTENING {server.port}", flush=True)
     server.serve_forever()

@@ -16,7 +16,9 @@ True`` runs the same kernels on CPU for tests.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import partial
+
+from ..caching.executable_cache import jit_memo
 
 import jax
 import jax.numpy as jnp
@@ -67,7 +69,7 @@ def _segment_sum_kernel(G: int, vals_ref, gid_ref, live_ref, out_ref):
         out_ref[g, :] = out_ref[g, :] + jnp.sum(sel, axis=0)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("pallas._build")
 def _build(G: int, n_blocks: int, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -230,7 +232,7 @@ def _hash_probe_kernel(P: int, S: int, block: int, table_ref, sgid_ref,
                       jnp.int32(0))
 
 
-@lru_cache(maxsize=None)
+@jit_memo("pallas._build_insert")
 def _build_insert(P: int, S: int, n_blocks: int, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -261,7 +263,7 @@ def _build_insert(P: int, S: int, n_blocks: int, interpret: bool):
     return jax.jit(run)
 
 
-@lru_cache(maxsize=None)
+@jit_memo("pallas._build_probe")
 def _build_probe(P: int, S: int, n_blocks: int, interpret: bool):
     from jax.experimental import pallas as pl
 

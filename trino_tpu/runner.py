@@ -41,7 +41,8 @@ def count_result(name: str, n: int) -> "QueryResult":
         [name], [Column(BIGINT, np.array([n], np.int64))]))
 
 
-def _refresh_materialized_view(name: str, catalog, run_select) -> int:
+def _refresh_materialized_view(name: str, catalog, run_select,
+                               default_catalog_name: str = "memory") -> int:
     """(Re)materialize a view into its backing table in the 'memory'
     catalog; returns the row count (reference:
     operator/RefreshMaterializedViewOperator.java:27)."""
@@ -50,6 +51,19 @@ def _refresh_materialized_view(name: str, catalog, run_select) -> int:
 
     view = catalog.views[name]
     conn = catalog.connector("memory")
+    # capture the base tables' data_version vector BEFORE reading them:
+    # Catalog.mv_is_stale compares these against current tokens, and a base
+    # mutation racing the refresh must leave the MV looking stale, not fresh
+    try:
+        from .caching import plan_cache
+        from .planner.logical import LogicalPlanner
+
+        base_plan = LogicalPlanner(catalog, default_catalog_name).plan(
+            ast.QueryStatement(view.query))
+        base_versions = catalog.table_versions(
+            plan_cache.scan_tables(base_plan))
+    except Exception:  # noqa: BLE001 — staleness stays conservative (None)
+        base_versions = None
     result = run_select(ast.QueryStatement(view.query))
     batch = result.batch.compact()
     backing = f"__mv_{name}"
@@ -61,6 +75,7 @@ def _refresh_materialized_view(name: str, catalog, run_select) -> int:
     sink.append(batch.rename(list(result.names)))
     conn.finish_insert(backing, sink.finish())
     view.backing = ("memory", backing)
+    view.base_versions = base_versions
     return batch.num_rows
 
 
@@ -120,6 +135,17 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
     columns, DROP TABLE, DELETE).  Returns None for non-DDL statements.
     Reference: metadata/MetadataManager create/drop, and DELETE planned as
     scan+filter+rewrite (the simple connectors have no row-id deletes)."""
+    out = _execute_ddl(stmt, catalog, default_catalog_name, run_select)
+    if out is not None:
+        # any metadata statement (DDL, views, functions, ANALYZE stats,
+        # procedures) may change how future statements plan: cached
+        # logical plans against the old catalog state must miss
+        catalog.bump_generation()
+    return out
+
+
+def _execute_ddl(stmt, catalog, default_catalog_name: str,
+                 run_select) -> Optional["QueryResult"]:
     from .spi.connector import ColumnSchema, TableSchema
     from .spi.types import parse_type
 
@@ -144,7 +170,8 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
             raise ValueError(f"view already exists: {name}")
         catalog.views[name] = ViewDefinition(stmt.query, stmt.materialized)
         if stmt.materialized:
-            _refresh_materialized_view(name, catalog, run_select)
+            _refresh_materialized_view(name, catalog, run_select,
+                                       default_catalog_name)
         return count_result("rows", 0)
     if isinstance(stmt, ast.DropView):
         name = stmt.name.split(".")[-1]
@@ -160,7 +187,8 @@ def execute_ddl(stmt, catalog, default_catalog_name: str,
         name = stmt.name.split(".")[-1]
         if name not in catalog.views or not catalog.views[name].materialized:
             raise KeyError(f"no such materialized view: {name}")
-        rows = _refresh_materialized_view(name, catalog, run_select)
+        rows = _refresh_materialized_view(name, catalog, run_select,
+                                          default_catalog_name)
         return count_result("rows", rows)
     if isinstance(stmt, ast.CallProcedure):
         cat, proc = _split_name(stmt.name, default_catalog_name)
@@ -309,6 +337,15 @@ def run_with_query_events(qid: str, sql: str, user: str, listeners, tracer,
         # profile store before the rings can wrap (worker-process events
         # arrive separately, via task status JSON)
         profiler.harvest(qid)
+        # Tier B warm journal: persist any memo keys this query minted so
+        # the next process can pre-instantiate them at boot (no-op when
+        # nothing changed — one flag check per query)
+        try:
+            from .caching import executable_cache
+
+            executable_cache.flush_warm_keys()
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            pass
         profiler.apply_context(prof_ctx)
         listeners.query_completed(QueryCompletedEvent(
             qid, sql, state, user, wall, rows, error,
@@ -509,6 +546,9 @@ class StandaloneQueryRunner:
         j = _journal.get_journal()
         if j is not None:
             self.event_listeners.add(j)
+        from .caching import executable_cache
+
+        executable_cache.init_compile_cache()
 
     def create_plan(self, sql: str) -> PlanNode:
         return self._plan_stmt(parse_statement(sql))
@@ -540,6 +580,14 @@ class StandaloneQueryRunner:
         return profiler.chrome_trace(query_id)
 
     def _execute(self, sql: str) -> QueryResult:
+        from .caching import plan_cache, result_cache
+
+        # Tier A fast path: a cached plan skips parse → analyze → plan →
+        # optimize entirely (only statements that reached _plan_stmt are
+        # ever stored, so DDL/session/transaction texts always miss here)
+        entry = plan_cache.lookup(sql, self.session, self.catalog)
+        if entry is not None:
+            return self._execute_cached_plan(entry)
         stmt = parse_statement(sql)
         from .execution.transaction import handle_transaction_stmt
 
@@ -566,7 +614,33 @@ class StandaloneQueryRunner:
                           lambda st: self._execute_stmt(st, False)[0])
         if ddl is not None:
             return ddl
-        result, _ = self._execute_stmt(stmt, collect_stats=False)
+        plan = self._plan_stmt(stmt)
+        entry = plan_cache.store(sql, self.session, self.catalog, plan)
+        # Tier C: capture the table-version vector BEFORE executing — a
+        # mutation racing the read then strands the entry under a stale
+        # key (never served) instead of publishing stale data as fresh
+        versions = result_cache.version_vector(entry.tables, self.catalog)
+        key = result_cache.result_key(entry, versions)
+        result, _ = self._execute_stmt(stmt, collect_stats=False, plan=plan)
+        result_cache.store(key, result, entry.tables)
+        return result
+
+    def _execute_cached_plan(self, entry) -> QueryResult:
+        """Run from a Tier-A hit: re-check access (the cache is keyed on
+        session knobs, not identity), try Tier C, else execute a private
+        clone of the cached tree and publish the result."""
+        from .caching import plan_cache, result_cache
+
+        check_select_access(entry.plan, self.access_control,
+                            self.session.user)
+        versions = result_cache.version_vector(entry.tables, self.catalog)
+        key = result_cache.result_key(entry, versions)
+        cached = result_cache.lookup(key)
+        if cached is not None:
+            return cached
+        result, _ = self._execute_stmt(
+            None, collect_stats=False, plan=plan_cache.clone(entry.plan))
+        result_cache.store(key, result, entry.tables)
         return result
 
     def _execute_stmt(self, stmt: ast.Statement, collect_stats: bool,

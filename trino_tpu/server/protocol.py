@@ -256,6 +256,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if parts == ["v1", "caches"]:
+            # per-tier cache-plane stats (same rows as system.runtime.caches)
+            from .. import caching
+
+            self._send(200, {"caches": caching.cache_rows(
+                per_exec_cache=qs.get("detail", [""])[0] == "1")})
+            return
         if len(parts) == 4 and parts[:2] == ["v1", "query"] and \
                 parts[3] == "profile":
             # flight-recorder timeline as Chrome trace_event JSON

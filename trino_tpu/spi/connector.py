@@ -140,6 +140,15 @@ class Connector:
         spi/procedure/Procedure.java; invoked by CALL)."""
         return {}
 
+    def data_version(self, table: str) -> Optional[Any]:
+        """Opaque token that changes whenever the table's data changes
+        (the caching plane's invalidation currency: result-cache keys and
+        MV staleness both compare these).  None means *unversioned* —
+        reads of this table are never result-cached (the right answer for
+        volatile sources like the system connector).  Immutable sources
+        return a constant (tpch: the scale factor)."""
+        return None
+
 
     def column_dictionary(self, table: str, column: str) -> Optional[np.ndarray]:
         """Table-global sorted dictionary for a string column, if known."""

@@ -220,7 +220,23 @@ class QueryJournal(EventListener):
 
 _SINGLETON: Optional[QueryJournal] = None
 _SINGLETON_LOCK = threading.Lock()
-_SEED_CACHE: Optional[dict] = None
+# fingerprint → [peaks] seed map, keyed by the journal file-set signature
+# it was built from: (sig, cache).  Rebuilt only when a journal file
+# appears/rotates/grows — an admission decision costs a stat() per file,
+# not a full re-read
+_SEED_CACHE: Optional[tuple] = None
+_SEED_LOCK = threading.Lock()
+
+
+def _journal_signature(j: QueryJournal) -> tuple:
+    sig = []
+    for path in j.files():
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        sig.append((path, st.st_size, st.st_mtime_ns))
+    return tuple(sig)
 
 
 def get_journal() -> Optional[QueryJournal]:
@@ -247,21 +263,27 @@ def history() -> list[dict]:
 
 def seeded_peak(fp: str, history_len: int = 5) -> int:
     """Journal-seeded admission estimate: max peak of the fingerprint's
-    most recent FINISHED runs on disk, 0 when unknown.  Loaded once per
-    process — live runs land in telemetry/runtime.py and take precedence,
-    so the cache only has to cover the cold-start window."""
+    most recent FINISHED runs on disk, 0 when unknown.  The seed map is
+    memoized on the journal file-set signature (path, size, mtime), so
+    steady-state admission does a handful of stat() calls and re-reads the
+    files only when another coordinator appended or a rotation happened."""
     global _SEED_CACHE
-    if _SEED_CACHE is None:
-        cache: dict[str, list[int]] = {}
-        for rec in history():
-            if rec.get("state") != "FINISHED":
-                continue
-            peak = int(rec.get("peak_memory_bytes", 0) or 0)
-            if peak <= 0:
-                continue
-            cache.setdefault(rec.get("fingerprint", ""), []).append(peak)
-        _SEED_CACHE = cache
-    peaks = _SEED_CACHE.get(fp)
+    j = get_journal()
+    if j is None:
+        return 0
+    with _SEED_LOCK:
+        sig = _journal_signature(j)
+        if _SEED_CACHE is None or _SEED_CACHE[0] != sig:
+            cache: dict[str, list[int]] = {}
+            for rec in j.read(events=("query_completed",)):
+                if rec.get("state") != "FINISHED":
+                    continue
+                peak = int(rec.get("peak_memory_bytes", 0) or 0)
+                if peak <= 0:
+                    continue
+                cache.setdefault(rec.get("fingerprint", ""), []).append(peak)
+            _SEED_CACHE = (sig, cache)
+        peaks = _SEED_CACHE[1].get(fp)
     if not peaks:
         return 0
     return max(peaks[-history_len:])

@@ -487,6 +487,45 @@ JOURNAL_BYTES = REGISTRY.counter("trino_journal_bytes_total",
 JOURNAL_ROTATIONS = REGISTRY.counter("trino_journal_rotations_total",
                                      "query journal file rotations")
 
+# three-tier cache plane (trino_tpu/caching/): Tier A logical plans,
+# Tier B compiled-executable registry, Tier C versioned results
+CACHE_PLAN_HITS = REGISTRY.counter(
+    "trino_cache_plan_hits_total", "logical-plan cache hits")
+CACHE_PLAN_MISSES = REGISTRY.counter(
+    "trino_cache_plan_misses_total", "logical-plan cache misses")
+CACHE_PLAN_EVICTIONS = REGISTRY.counter(
+    "trino_cache_plan_evictions_total", "logical-plan cache LRU evictions")
+CACHE_PLAN_INVALIDATIONS = REGISTRY.counter(
+    "trino_cache_plan_invalidations_total",
+    "logical-plan cache entries dropped by invalidation")
+CACHE_PLAN_ENTRIES = REGISTRY.gauge(
+    "trino_cache_plan_entries", "logical-plan cache resident entries")
+CACHE_EXEC_HITS = REGISTRY.counter(
+    "trino_cache_exec_hits_total", "executable-registry memo hits")
+CACHE_EXEC_MISSES = REGISTRY.counter(
+    "trino_cache_exec_misses_total",
+    "executable-registry memo misses (new wrapper instantiated)")
+CACHE_EXEC_EVICTIONS = REGISTRY.counter(
+    "trino_cache_exec_evictions_total",
+    "executable-registry LRU evictions")
+CACHE_EXEC_ENTRIES = REGISTRY.gauge(
+    "trino_cache_exec_entries",
+    "executable-registry resident entries, all caches")
+CACHE_RESULT_HITS = REGISTRY.counter(
+    "trino_cache_result_hits_total", "versioned result cache hits")
+CACHE_RESULT_MISSES = REGISTRY.counter(
+    "trino_cache_result_misses_total", "versioned result cache misses")
+CACHE_RESULT_EVICTIONS = REGISTRY.counter(
+    "trino_cache_result_evictions_total",
+    "result cache LRU evictions under the byte budget")
+CACHE_RESULT_INVALIDATIONS = REGISTRY.counter(
+    "trino_cache_result_invalidations_total",
+    "result cache entries dropped by table mutation")
+CACHE_RESULT_ENTRIES = REGISTRY.gauge(
+    "trino_cache_result_entries", "result cache resident entries")
+CACHE_RESULT_BYTES = REGISTRY.gauge(
+    "trino_cache_result_bytes", "result cache resident bytes")
+
 
 # ------------------------------------------------------------ observe hooks
 def resource_group_gauges(path: str):

@@ -176,38 +176,44 @@ def run_scenario(seed: int, mode: str = "inproc", n_queries: int = 8,
             env_overrides=_ENV)
         faults = _PROCESS_FAULTS
 
+    from ..caching import result_cache
+
     outcomes = []
     try:
-        for qi in range(n_queries):
-            sql = (USER_ERROR_SQL if rng.random() < 0.12
-                   else rng.choice(QUERY_MIX))
-            fault = rng.choice(faults)
-            task_index = rng.randrange(2)
-            if fault == TASK_STALL:
-                inj.inject(TASK_STALL, fragment_id=None,
-                           task_index=task_index, attempt=0, times=1,
-                           stall_s=round(0.3 + rng.random() * 0.5, 2))
-            elif fault not in ("none", "drain"):
-                inj.inject(fault, fragment_id=None,
-                           task_index=task_index, attempt=0, times=1)
+        # the soak certifies *execution* under faults — a cached result for
+        # a repeated mix query would skip the fragment path and leave the
+        # armed injection waiting for the wrong query
+        with result_cache.disabled():
+            for qi in range(n_queries):
+                sql = (USER_ERROR_SQL if rng.random() < 0.12
+                       else rng.choice(QUERY_MIX))
+                fault = rng.choice(faults)
+                task_index = rng.randrange(2)
+                if fault == TASK_STALL:
+                    inj.inject(TASK_STALL, fragment_id=None,
+                               task_index=task_index, attempt=0, times=1,
+                               stall_s=round(0.3 + rng.random() * 0.5, 2))
+                elif fault not in ("none", "drain"):
+                    inj.inject(fault, fragment_id=None,
+                               task_index=task_index, attempt=0, times=1)
 
-            retries_before = runner.resilience.query_retries
-            if fault == "drain":
-                rows, exc, hung, wall = _run_with_drain(
-                    runner, sql, mode, rng, timeout)
-            else:
-                rows, exc, hung, wall = _execute_watched(
-                    runner, sql, timeout)
-            retried = runner.resilience.query_retries > retries_before
-            outcome, detail = _classify_outcome(
-                sql, rows, exc, hung, retried, expected)
-            outcomes.append({
-                "query": qi, "sql": sql, "fault": fault,
-                "outcome": outcome, "detail": detail,
-                "wall_s": round(wall, 3), "retried": retried,
-            })
-            if outcome == "hang":
-                break  # the runner is wedged; stop the scenario here
+                retries_before = runner.resilience.query_retries
+                if fault == "drain":
+                    rows, exc, hung, wall = _run_with_drain(
+                        runner, sql, mode, rng, timeout)
+                else:
+                    rows, exc, hung, wall = _execute_watched(
+                        runner, sql, timeout)
+                retried = runner.resilience.query_retries > retries_before
+                outcome, detail = _classify_outcome(
+                    sql, rows, exc, hung, retried, expected)
+                outcomes.append({
+                    "query": qi, "sql": sql, "fault": fault,
+                    "outcome": outcome, "detail": detail,
+                    "wall_s": round(wall, 3), "retried": retried,
+                })
+                if outcome == "hang":
+                    break  # the runner is wedged; stop the scenario here
     finally:
         close = getattr(runner, "close", None)
         if close is not None:

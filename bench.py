@@ -28,7 +28,10 @@ Subcommands: ``--scan`` (ingest microbench), ``--ndv [1e3,1e4,...]``
 ``--qps`` (two-tenant weighted-fair sustained-load harness + OOM drill,
 see run_qps_bench; BENCH_QPS_DURATION/BENCH_QPS_SF/BENCH_QPS_CLIENTS),
 ``--warm`` (cache-plane cold/warm/warm-after-mutation ladder, see
-run_warm_bench; BENCH_WARM_SF/BENCH_WARM_REPS).
+run_warm_bench; BENCH_WARM_SF/BENCH_WARM_REPS), ``--adaptive`` (adaptive
+execution on/off A/B over a skewed-key TPC-H variant and a mis-estimated
+broadcast plan, see run_adaptive_bench; BENCH_ADAPTIVE_SF/
+BENCH_ADAPTIVE_WORKERS).
 """
 
 from __future__ import annotations
@@ -682,6 +685,167 @@ def run_warm_bench(write: bool = True) -> dict:
     return result
 
 
+# --adaptive leg 1: ~80% of the probe rows collapse onto ONE join key, so a
+# static hash-partitioned join lands most of the work on a single task; the
+# runtime skew split fans that key out across several probe tasks.  count and
+# a DECIMAL sum only: both are exact and summation-order independent, so the
+# off/on row comparison is bit-for-bit even though the split reorders pages
+_ADAPTIVE_SKEW_SQL = """
+select count(*) n, sum(p.o_totalprice) s
+from (select case when o_orderkey % 5 < 4 then 1
+             else o_custkey end as k, o_totalprice from orders) p
+join (select c_custkey, c_acctbal from customer) b on p.k = b.c_custkey
+"""
+
+# --adaptive leg 2: a genuine optimizer mis-estimate.  The four always-true
+# range conjuncts each get the 0.4 one-sided-range selectivity from
+# _conjunct_selectivity, so the optimizer estimates the orders subquery at
+# 0.4^4 = 2.6% of its true size, makes it the smallest relation, and
+# BROADCASTs it as the build side — every task re-builds the full 150k*sf-row
+# hash table.  The runtime flip to PARTITIONED splits the build 1/n per task
+# (a WORK reduction, visible even on a single-core host)
+_ADAPTIVE_WRONG_SQL = """
+select c.c_mktsegment, count(*) n, sum(o.o_totalprice) s
+from customer c
+join (select o_custkey, o_totalprice from orders
+      where o_orderkey > -1 and o_orderkey > -2
+        and o_orderkey > -3 and o_orderkey > -4) o
+  on c.c_custkey = o.o_custkey
+group by c.c_mktsegment order by c.c_mktsegment
+"""
+
+
+@_result_cache_off
+def _adaptive_ab(sql: str, sf: float, workers: int, iters: int,
+                 env: dict, on_session_kw: dict) -> dict:
+    """One A/B leg: median wall for adaptive=0 vs adaptive=1 on a fresh
+    runner each, identical (sorted) rows required, decision tags captured
+    from the telemetry record of the adaptive run."""
+    from trino_tpu import caching
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.runner import Session
+    from trino_tpu.telemetry import runtime as rt
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        out: dict = {}
+        rows: dict[str, list] = {}
+        for mode, kw in (("off", {"adaptive": "0"}),
+                         ("on", dict(on_session_kw, adaptive="1"))):
+            caching.reset_for_test()
+            r = DistributedQueryRunner(
+                default_catalog(scale_factor=sf), worker_count=workers,
+                session=Session(node_count=workers, **kw))
+            r.execute(sql)  # warmup: compile every jitted program
+            samples = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                res = r.execute(sql)
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            rows[mode] = sorted(res.rows())
+            out[f"wall_s_{mode}"] = round(samples[len(samples) // 2], 3)
+            if mode == "on":
+                out["decisions"] = rt.queries()[-1].adaptive_decisions
+        out["speedup"] = round(out["wall_s_off"] / max(out["wall_s_on"],
+                                                       1e-9), 2)
+        out["rows_identical"] = rows["off"] == rows["on"]
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_adaptive_bench(write: bool = True) -> dict:
+    """``bench.py --adaptive``: the adaptive-execution acceptance A/B.
+
+    Two legs, each adaptive=1 vs the bit-for-bit legacy adaptive=0 on the
+    same data and plan inputs:
+
+    - **skewed_key** — ~80% of probe rows on one join key, static plan
+      forced PARTITIONED: the heavy partition serializes the legacy run;
+      the runtime skew split must cut wall by >= 2x.  A split moves no
+      work, it only balances it, so the wall target needs >= ``workers``
+      usable cores; on a smaller host the leg is judged on the measured
+      trino_adaptive_skew_imbalance_ratio gauge (max partition weight
+      before/after — exactly what a parallel host converts to wall) and
+      the JSON records which criterion applied.
+    - **wrong_side_broadcast** — a selectivity mis-estimate (stacked
+      always-true range conjuncts) broadcasts the big build side; the
+      runtime flip to PARTITIONED must cut wall by >= 1.5x.  The flip is
+      a work reduction (n duplicate hash builds -> 1), so the wall
+      target holds on any host.
+
+    Env knobs: BENCH_ADAPTIVE_SF (default 0.3), BENCH_ADAPTIVE_WORKERS
+    (default 4), BENCH_ITERS (default 3).  Writes BENCH_r13.json."""
+    sf = float(os.environ.get("BENCH_ADAPTIVE_SF", "0.3"))
+    workers = int(os.environ.get("BENCH_ADAPTIVE_WORKERS", "4"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    _ensure_backend()
+    _enable_compile_cache()
+
+    from trino_tpu.telemetry import metrics as tm
+    from trino_tpu.telemetry.metrics import REGISTRY
+
+    cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    print(f"adaptive A/B: sf={sf:g} workers={workers} cores={cores}",
+          file=sys.stderr)
+    # threshold=1 byte: the tiny build must NOT flip to broadcast, so the
+    # leg isolates the skew split (a broadcast flip would also fix skew,
+    # but it is leg 2's mechanism)
+    skew = _adaptive_ab(
+        _ADAPTIVE_SKEW_SQL, sf, workers, iters,
+        env={"TRINO_TPU_BROADCAST_ROW_LIMIT": "0"},
+        on_session_kw={"broadcast_threshold_bytes": 1, "skew_factor": 1.2})
+    skew["imbalance_ratio"] = round(tm.ADAPTIVE_SKEW_IMBALANCE.value(), 2)
+    print(f"skewed_key: {skew}", file=sys.stderr)
+    wrong = _adaptive_ab(
+        _ADAPTIVE_WRONG_SQL, sf, workers, iters,
+        env={}, on_session_kw={"broadcast_threshold_bytes": 1 << 20})
+    print(f"wrong_side_broadcast: {wrong}", file=sys.stderr)
+
+    # wall-clock is the skew criterion when the host can actually run the
+    # tasks in parallel; a 1-core container cannot turn load balance into
+    # wall, so there the sketch-measured imbalance ratio (what a parallel
+    # host realises) is the honest stand-in — recorded either way
+    skew_on_wall = cores >= workers
+    skew_ok = (skew["rows_identical"] and "skew_split" in skew["decisions"]
+               and (skew["speedup"] >= 2.0 if skew_on_wall
+                    else skew["imbalance_ratio"] >= 2.0))
+    result = {
+        "metric": f"adaptive_skew_split_speedup_sf{sf:g}",
+        "value": skew["speedup"],
+        "unit": "adaptive=0 wall / adaptive=1 wall "
+                "(skew target >= 2x, wrong-broadcast target >= 1.5x)",
+        "workers": workers,
+        "iters": iters,
+        "cores": cores,
+        "skew_criterion": ("wall_speedup >= 2.0" if skew_on_wall else
+                           "imbalance_ratio >= 2.0 (host has fewer cores "
+                           "than workers; wall cannot see load balance)"),
+        "skewed_key": skew,
+        "wrong_side_broadcast": wrong,
+        "pass": (skew_ok
+                 and wrong["speedup"] >= 1.5 and wrong["rows_identical"]
+                 and "flip_to_partitioned" in wrong["decisions"]),
+        "metrics": {k: v for k, v in REGISTRY.snapshot().items()
+                    if k.startswith("trino_adaptive")},
+    }
+    print(json.dumps(result))
+    if write:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r13.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
 def run_baseline() -> None:
     """CPU reference: same engine, same data, 8-worker DistributedQueryRunner.
     Runs in a subprocess with JAX_PLATFORMS=cpu (BASELINE.md config #1)."""
@@ -1243,6 +1407,9 @@ def main() -> None:
         return
     if "--warm" in sys.argv:
         run_warm_bench()
+        return
+    if "--adaptive" in sys.argv:
+        run_adaptive_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

@@ -98,7 +98,8 @@ def registrations(root: str) -> dict[str, list[tuple[str, int]]]:
 # expose (PR 11 flight recorder, PR 12 cache plane): at least one
 # registration of each must exist, so a refactor can't silently drop the
 # profiler/journal/cache telemetry
-REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_")
+REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_",
+                     "trino_adaptive_")
 
 
 def run(root: str, require_families: bool = False

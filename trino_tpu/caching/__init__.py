@@ -43,3 +43,6 @@ def reset_for_test() -> None:
     result_cache.reset_for_test()
     executable_cache.clear_all()
     executable_cache.reset_warm_state_for_test()
+    from ..exec import join_exec
+
+    join_exec.reset_estimate_seeds_for_test()

@@ -48,6 +48,7 @@ _TABLES = {
         ("input_bytes", BIGINT), ("retry_count", BIGINT),
         ("peak_memory_bytes", BIGINT), ("error", VARCHAR),
         ("queued_time_ms", DOUBLE), ("resource_group", VARCHAR),
+        ("adaptive_decisions", VARCHAR),
     ]),
     "runtime.resource_groups": _schema("runtime.resource_groups", [
         ("path", VARCHAR), ("policy", VARCHAR), ("weight", BIGINT),
@@ -150,7 +151,8 @@ class SystemConnector(Connector):
             out = [
                 (q.query_id, q.state, q.user, q.sql, q.wall_ms, q.cpu_ms,
                  q.output_rows, q.input_rows, q.input_bytes, q.retry_count,
-                 q.peak_memory_bytes, q.error, q.queued_ms, q.resource_group)
+                 q.peak_memory_bytes, q.error, q.queued_ms, q.resource_group,
+                 q.adaptive_decisions)
                 for q in runtime.queries()
             ]
             # dispatcher-tracked queries (control.py FSM) that predate or
@@ -163,7 +165,7 @@ class SystemConnector(Connector):
                     if info.query_id not in seen:
                         out.append((info.query_id, info.state, "", info.sql,
                                     0.0, 0.0, -1, 0, 0, 0, 0, None, 0.0,
-                                    info.resource_group))
+                                    info.resource_group, ""))
             return out
         if table == "runtime.resource_groups":
             runner = self._runner() if self._runner is not None else None

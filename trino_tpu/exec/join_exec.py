@@ -499,6 +499,23 @@ EST_HEADROOM = 2          # estimated cap = headroom * max recent total
 EST_WINDOW = 8            # totals remembered for the estimate
 
 
+# Cross-execution feedback: the max observed candidate total per stable
+# operator identity.  A fresh operator for the same plan shape seeds its
+# estimate from the last execution instead of cold-starting at n_probe —
+# a repartitioned probe arriving as one large page otherwise overflows its
+# first cap and re-runs the whole pair program (correct, but double work).
+# Correctness never depends on a seed: the overflow flag still guards
+# every estimated cap, a stale seed only costs padding.
+_EST_SEEDS: dict = {}
+_EST_SEEDS_CAP = 4096
+_EST_SEEDS_LOCK = threading.Lock()
+
+
+def reset_estimate_seeds_for_test() -> None:
+    with _EST_SEEDS_LOCK:
+        _EST_SEEDS.clear()
+
+
 class ExpandPlanner:
     """Per-probe-operator planner for the padded-expand output bucket.
 
@@ -508,12 +525,19 @@ class ExpandPlanner:
     back to an adaptive estimate fed by asynchronously-landed totals of
     previous batches.  On the estimated path the caller must check the
     expand program's overflow flag before emitting; ``observe`` feeds the
-    planner so steady state converges to zero overflows."""
+    planner so steady state converges to zero overflows.  With a ``key``
+    the planner also reads/writes the process-global seed store, so the
+    convergence carries across executions of the same plan shape."""
 
-    __slots__ = ("_totals", "_pending")
+    __slots__ = ("_totals", "_pending", "_key")
 
-    def __init__(self):
-        self._totals: list[int] = []
+    def __init__(self, key=None):
+        self._key = key
+        seed = None
+        if key is not None:
+            with _EST_SEEDS_LOCK:
+                seed = _EST_SEEDS.get(key)
+        self._totals: list[int] = [seed] if seed else []
         self._pending: list[SG.AsyncScalar] = []
 
     def plan(self, n_probe: int, max_run: Optional[int]) -> tuple[int, bool]:
@@ -544,8 +568,16 @@ class ExpandPlanner:
         return max(self._totals) if self._totals else None
 
     def observe(self, total: int) -> None:
-        self._totals.append(int(total))
+        total = int(total)
+        self._totals.append(total)
         del self._totals[:-EST_WINDOW]
+        if self._key is not None:
+            with _EST_SEEDS_LOCK:
+                if total > _EST_SEEDS.get(self._key, 0):
+                    if (self._key not in _EST_SEEDS
+                            and len(_EST_SEEDS) >= _EST_SEEDS_CAP):
+                        _EST_SEEDS.clear()  # coarse bound; seeds re-learn
+                    _EST_SEEDS[self._key] = total
 
     def _drain(self) -> None:
         still = []

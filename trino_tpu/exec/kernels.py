@@ -1629,8 +1629,11 @@ def compact_device_batch(batch, live_count: int):
     return ColumnBatch(batch.names, cols, outs[-1])
 
 
-def partition_assignments(keys: Sequence[tuple], num_partitions: int) -> np.ndarray:
-    """Row -> partition id by key hash (NULL keys -> partition 0)."""
+def partition_key_hashes(keys: Sequence[tuple]) -> np.ndarray:
+    """Row -> uint64 key hash with NULL keys forced to 0.  The single
+    routing hash shared by the shuffle sink and the adaptive routers: both
+    must agree bit-for-bit on where a key lands (``h % n`` with null->0
+    matches the legacy null->partition-0 placement for any n)."""
     datas = [jnp.asarray(d) for d, _ in keys]
     h = hash_combine(datas)
     null_mask = None
@@ -1638,7 +1641,12 @@ def partition_assignments(keys: Sequence[tuple], num_partitions: int) -> np.ndar
         if v is not None:
             nm = ~jnp.asarray(v)
             null_mask = nm if null_mask is None else (null_mask | nm)
-    part = (h % jnp.uint64(num_partitions)).astype(jnp.int32)
     if null_mask is not None:
-        part = jnp.where(null_mask, 0, part)
-    return np.asarray(part)
+        h = jnp.where(null_mask, jnp.uint64(0), h)
+    return np.asarray(h)
+
+
+def partition_assignments(keys: Sequence[tuple], num_partitions: int) -> np.ndarray:
+    """Row -> partition id by key hash (NULL keys -> partition 0)."""
+    h = partition_key_hashes(keys)
+    return (h % np.uint64(num_partitions)).astype(np.int32)

@@ -1498,8 +1498,12 @@ class LookupJoinOperator(Operator):
         # sync-free expand state: capacity planners fed by async-landed
         # totals, and the deferred-commit queue for estimated-cap batches
         # whose overflow flag is still in flight (exec/join_exec.py)
-        self._planner = JX.ExpandPlanner()
-        self._uplanner = JX.ExpandPlanner()
+        # keyed planners: the same join shape re-planned in a later
+        # execution starts from the prior run's observed totals
+        ident = (join_type, tuple(self.left_keys),
+                 tuple(self.output_names), residual is not None)
+        self._planner = JX.ExpandPlanner(key=("pairs",) + ident)
+        self._uplanner = JX.ExpandPlanner(key=("unique",) + ident)
         self._inflight = JX.OverflowQueue()
         self.pending_errors: list = []  # deferred cardinality violations
 
@@ -1885,7 +1889,9 @@ class SemiJoinOperator(Operator):
         from . import join_exec as JX
 
         self._pending: "deque[ColumnBatch]" = deque()
-        self._planner = JX.ExpandPlanner()
+        self._planner = JX.ExpandPlanner(key=(
+            "semi", tuple(self.source_keys), null_aware,
+            tuple(self.output_names), residual is not None))
         self._inflight = JX.OverflowQueue()
 
     def needs_input(self) -> bool:

@@ -207,6 +207,50 @@ class FusedStageStats:
 
 
 @dataclass
+class AdaptiveStats:
+    """Counters + decision tags for the adaptive execution plane
+    (execution/adaptive.py): phased stage activations and the join-
+    distribution / skew decisions taken at activation barriers.  The
+    ``decisions`` list carries compact human-readable tags
+    (``flip_to_broadcast[f3]``, ``skew_split[f5:k2]``, ``keep[f3]``) that
+    surface verbatim in EXPLAIN ANALYZE and system.runtime.queries."""
+
+    activations: int = 0       # stages activated by the phased scheduler
+    decision_points: int = 0   # barriers where a decision was evaluated
+    broadcast_flips: int = 0   # PARTITIONED -> REPLICATED rewrites
+    partition_flips: int = 0   # REPLICATED -> PARTITIONED rewrites
+    skew_splits: int = 0       # heavy keys split across probe tasks
+    memo_hits: int = 0         # decisions replayed from the runtime memo
+    decisions: list[str] = field(default_factory=list)
+
+    def merge(self, other: "AdaptiveStats") -> None:
+        self.activations += other.activations
+        self.decision_points += other.decision_points
+        self.broadcast_flips += other.broadcast_flips
+        self.partition_flips += other.partition_flips
+        self.skew_splits += other.skew_splits
+        self.memo_hits += other.memo_hits
+        self.decisions.extend(other.decisions)
+
+    @property
+    def any(self) -> bool:
+        return any((self.activations, self.decision_points,
+                    self.broadcast_flips, self.partition_flips,
+                    self.skew_splits))
+
+    def text(self) -> str:
+        tags = ", ".join(self.decisions) if self.decisions else "none"
+        return (
+            f"adaptive: {self.activations} phased activations, "
+            f"{self.decision_points} decision points "
+            f"({self.broadcast_flips} -> broadcast, "
+            f"{self.partition_flips} -> partitioned, "
+            f"{self.skew_splits} skew splits, "
+            f"{self.memo_hits} memo hits); decisions: {tags}"
+        )
+
+
+@dataclass
 class OperatorStats:
     name: str
     input_rows: int = 0
@@ -231,6 +275,7 @@ class QueryStats:
     sync: "object | None" = None  # syncguard.SyncStats delta for this query
     resilience: ResilienceStats | None = None  # retry/heartbeat delta
     fused: FusedStageStats | None = None  # whole-stage compilation counters
+    adaptive: AdaptiveStats | None = None  # adaptive-execution decisions
 
     def merge_scan(self, ingest: ScanIngestStats) -> None:
         if self.scan is None:
@@ -261,6 +306,8 @@ class QueryStats:
             lines.append("  " + self.resilience.text())
         if self.fused is not None and self.fused.any:
             lines.append("  " + self.fused.text())
+        if self.adaptive is not None and self.adaptive.any:
+            lines.append("  " + self.adaptive.text())
         for i, p in enumerate(self.pipelines):
             lines.append(f"  pipeline {i}:")
             for op in p.operators:

@@ -104,9 +104,13 @@ class DistributedQueryRunner:
         # placement (remote) / speculation stats across queries
         from .speculation import ClusterBlacklist
 
+        # persist=True: strikes are journaled (telemetry/journal.py) and the
+        # TTL-decayed remainder re-seeds this blacklist after a restart —
+        # a flaky worker does not get a clean slate from a coordinator bounce
         self.cluster_blacklist = ClusterBlacklist(
             ttl_s=self.session.blacklist_ttl_s,
-            threshold=self.session.blacklist_threshold)
+            threshold=self.session.blacklist_threshold,
+            persist=True)
         # cumulative speculation outcome counters (per-query details go to
         # resilience_events)
         self.speculative_starts = 0
@@ -306,8 +310,12 @@ class DistributedQueryRunner:
                         # score the failure cross-query too: enough strikes
                         # within the TTL and the worker stops receiving
                         # tasks from NEW queries as well
+                        from ..telemetry import runtime as _rt2
+
+                        _rec = _rt2.current_record()
                         self.cluster_blacklist.record_failure(
-                            te.remote_host, reason=te.code.name)
+                            te.remote_host, reason=te.code.name,
+                            query_id=_rec.query_id if _rec else "")
                     self._prepare_retry()
                     backoff.failure()
                     delay = backoff.delay_s
@@ -382,19 +390,13 @@ class DistributedQueryRunner:
         stages: dict[int, _Stage] = {
             f.id: _Stage(f, task_counts[f.id], []) for f in fragments
         }
-        # TIME_SHARING: enqueue backpressure can pin a bounded worker inside
-        # its quantum (sinks have no non-blocking mode yet), so that path
-        # gets a LARGER cap — but a real one: 1 GiB default, never the old
-        # 1 << 62 escape hatch.  TRINO_TPU_SINK_MAX_BYTES overrides both
-        # caps; parking a blocked driver instead of buffering stays a
-        # ROADMAP item ("bounded buffers everywhere").
+        # One byte budget for every scheduler: the time-sharing executor
+        # flips sinks non-blocking, whose drivers then park via
+        # ``needs_input`` until consumer acks free capacity — no quantum is
+        # ever pinned inside ``enqueue``, so the old 1 GiB escape cap is
+        # gone.  TRINO_TPU_SINK_MAX_BYTES overrides.
         env_cap = os.environ.get("TRINO_TPU_SINK_MAX_BYTES")
-        if env_cap:
-            sink_cap = max(int(env_cap), 1 << 20)
-        elif self.session.task_scheduler == "TIME_SHARING":
-            sink_cap = 1 << 30
-        else:
-            sink_cap = 256 << 20
+        sink_cap = max(int(env_cap), 1 << 20) if env_cap else 256 << 20
         for f in fragments:
             tc = stages[f.id].task_count
             nparts = consumer_tasks.get(f.id, 1)
@@ -432,6 +434,7 @@ class DistributedQueryRunner:
         edges = {**collective_edges, **fused_edges}
 
         errors: list[BaseException] = []
+        adaptive = None
         if self.session.task_scheduler == "TIME_SHARING":
             hung = self._run_time_sharing(
                 fragments, stages, errors, stats_sink, edges,
@@ -456,6 +459,18 @@ class DistributedQueryRunner:
                 speculation_enabled,
             )
 
+            # adaptive execution plane (execution/adaptive.py): phased
+            # activation + runtime join-distribution decisions.  ``0`` is
+            # bit-for-bit legacy; ``auto`` engages only when the plan has
+            # decision edges; ``1`` forces phased scheduling regardless.
+            from .adaptive import AdaptiveExec, adaptive_mode
+
+            mode = adaptive_mode(self.session)
+            if mode != "0":
+                adaptive = AdaptiveExec(stages, fragments, edges,
+                                        sink_cap, self.session, errors)
+                if mode == "auto" and not adaptive.sites:
+                    adaptive = None
             spec: Optional[StreamingSpeculation] = None
             spec_gates: dict = {}
             if speculation_enabled(self.session):
@@ -472,31 +487,46 @@ class DistributedQueryRunner:
                 for f in fragments:
                     if (f.source_fragments or f.id in edges
                             or stages[f.id].task_count < 2
-                            or _writes(f.root)):
+                            or _writes(f.root)
+                            or (adaptive is not None
+                                and adaptive.is_deferred_producer(f.id))):
                         continue  # twin needs re-readable, side-effect-free
+                        # (deferred producers also feed barrier statistics:
+                        # a twin would double-count the staging sketch)
                     spec.register_stage(f.id, stages[f.id].task_count)
                     for t in range(stages[f.id].task_count):
                         spec_gates[(f.id, t)] = spec.register_task(f.id, t)
-            threads: list[threading.Thread] = []
-            for f in fragments:
-                stage = stages[f.id]
+
+            def _spawn_stage(fid: int) -> list[threading.Thread]:
+                stage = stages[fid]
+                out = []
                 for t in range(stage.task_count):
                     ctx = None
-                    if (f.id, t) in spec_gates:
-                        ctx = {"gate": spec_gates[(f.id, t)],
+                    if (fid, t) in spec_gates:
+                        ctx = {"gate": spec_gates[(fid, t)],
                                "kind": STANDARD,
-                               "cancel": spec.cancel_event(f.id, t, STANDARD)}
+                               "cancel": spec.cancel_event(fid, t, STANDARD)}
                     th = threading.Thread(
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
                               edges, attempt, parent_span, qrec, mem_qid,
-                              ctx),
-                        name=f"task-{f.id}.{t}",
+                              ctx, adaptive),
+                        name=f"task-{fid}.{t}",
                         daemon=True,
                     )
-                    threads.append(th)
-            for th in threads:
-                th.start()
+                    th.start()
+                    out.append(th)
+                return out
+
+            if adaptive is None:
+                threads: list[threading.Thread] = []
+                for f in fragments:
+                    threads.extend(_spawn_stage(f.id))
+            else:
+                # phased activation: only groups with no unresolved
+                # decision sites upstream get tasks now; the rest hold no
+                # threads or buffers' worth of pages and stay rewritable
+                threads = adaptive.start(_spawn_stage)
 
             def _spawn_twin(fid: int, t: int) -> threading.Thread:
                 # twin attempts use attempt+1000 (mirrors fte.py's
@@ -509,7 +539,7 @@ class DistributedQueryRunner:
                     target=self._run_task,
                     args=(stages[fid], t, stages, errors, stats_sink,
                           edges, attempt + 1000, parent_span, qrec,
-                          mem_qid, twin_ctx),
+                          mem_qid, twin_ctx, adaptive),
                     name=f"task-{fid}.{t}-speculative",
                     daemon=True,
                 )
@@ -523,9 +553,21 @@ class DistributedQueryRunner:
             deadline = time.monotonic() + 2 * STALL_TIMEOUT_S
             pending = list(threads)
             aborted = False
-            while pending and time.monotonic() < deadline:
-                pending[0].join(timeout=0.1)
+            while ((pending
+                    or (adaptive is not None and not adaptive.done()))
+                   and time.monotonic() < deadline):
+                if pending:
+                    pending[0].join(timeout=0.1)
+                else:
+                    time.sleep(0.02)
                 pending = [th for th in pending if th.is_alive()]
+                if adaptive is not None:
+                    if errors or aborted:
+                        # a failed task already aborted the buffers; force
+                        # the plane done so un-activated groups never spawn
+                        adaptive.abort()
+                    else:
+                        pending.extend(adaptive.advance(_spawn_stage))
                 if spec is not None and not errors and not aborted:
                     pending.extend(spec.tick(_spawn_twin))
                 if not aborted and handle.poll() is not None:
@@ -535,7 +577,11 @@ class DistributedQueryRunner:
                             b.abort()
                     for ex in edges.values():
                         ex.abort()
+                    if adaptive is not None:
+                        adaptive.abort()
             hung = [th.name for th in pending if th.is_alive()]
+            if adaptive is not None and not errors:
+                hung += adaptive.unactivated()
             if spec is not None:
                 self.speculative_starts += spec.starts
                 self.speculative_wins += spec.wins
@@ -552,6 +598,8 @@ class DistributedQueryRunner:
                     b.abort()
             for ex in edges.values():
                 ex.abort()
+            if adaptive is not None:
+                adaptive.abort()
             if kerr is not None:
                 # the kill verdict wins over secondary task errors: aborted
                 # buffers make tasks fail with cascade exceptions that would
@@ -593,6 +641,14 @@ class DistributedQueryRunner:
             if stats_sink is not None:
                 stats_sink.append(QueryStats(label="fused stages:",
                                              fused=roll))
+
+        if adaptive is not None and adaptive.stats.any:
+            from ..telemetry.metrics import observe_adaptive
+
+            observe_adaptive(adaptive.stats)
+            if stats_sink is not None:
+                stats_sink.append(QueryStats(label="adaptive:",
+                                             adaptive=adaptive.stats))
 
         # drain the root stage's buffer as the client
         from .task import maybe_deserialize
@@ -754,6 +810,7 @@ class DistributedQueryRunner:
                     attempt: int = 0,
                     memory_owner: Optional[str] = None,
                     spec_ctx: Optional[dict] = None,
+                    adaptive=None,
                     ) -> tuple[list, Optional[QueryStats]]:
         from .speculation import SpeculationLost
 
@@ -779,7 +836,13 @@ class DistributedQueryRunner:
             injector.maybe_fail(TASK_FAILURE, f.id, task_index, attempt)
         clients = {}
         for src in f.source_fragments:
-            if src in collective:
+            routed = (adaptive.routed_buffer(src)
+                      if adaptive is not None else None)
+            if routed is not None:
+                # deferred edge: consume the router's re-distributed pages,
+                # not the producer's staging buffers
+                clients[src] = ExchangeClient([routed], task_index)
+            elif src in collective:
                 clients[src] = collective[src]
             elif stages[src].fragment.output_kind == "MERGE":
                 # order-preserving gather: one client PER producer so the
@@ -830,10 +893,21 @@ class DistributedQueryRunner:
                 from .speculation import GatedBuffer
 
                 out = GatedBuffer(out, spec_ctx["gate"], spec_ctx["kind"])
+            kind = f.output_kind if f.output_kind != "OUTPUT" else "GATHER"
+            sketch, sketch_keys = None, ()
+            if adaptive is not None:
+                ov = adaptive.sink_override(f.id, task_index)
+                if ov is not None:
+                    # deferred producer: land everything in the single-
+                    # partition staging buffer (already swapped into
+                    # stage.buffers) and feed the heavy-hitter sketch
+                    kind = "GATHER"
+                    sketch, sketch_keys = ov
             sink = PartitionedOutputSink(
-                out,
-                f.output_kind if f.output_kind != "OUTPUT" else "GATHER",
-                f.output_keys, serde=self.session.exchange_serde)
+                out, kind,
+                f.output_keys, serde=self.session.exchange_serde,
+                sketch=sketch, sketch_keys=sketch_keys,
+                coalesce_rows=f.sink_coalesce_rows)
         local.pipelines[-1][-1] = sink
         stats = None
         if stats_sink is not None:
@@ -929,7 +1003,8 @@ class DistributedQueryRunner:
                   collective: Optional[dict] = None,
                   attempt: int = 0, parent_span=None,
                   query_record=None, memory_owner=None,
-                  spec_ctx: Optional[dict] = None) -> None:
+                  spec_ctx: Optional[dict] = None,
+                  adaptive=None) -> None:
         import time as _time
 
         from ..exec.driver import collect_scan_stats
@@ -961,7 +1036,8 @@ class DistributedQueryRunner:
             try:
                 pipelines, stats = self._build_task(
                     stage, task_index, stages, stats_sink, collective or {},
-                    attempt, memory_owner=memory_owner, spec_ctx=spec_ctx)
+                    attempt, memory_owner=memory_owner, spec_ctx=spec_ctx,
+                    adaptive=adaptive)
                 run_pipelines(pipelines, stats)
             except SpeculationLost:
                 # this attempt lost the first-commit race — its twin owns
@@ -995,6 +1071,8 @@ class DistributedQueryRunner:
                             b.abort()
                     for ex in (collective or {}).values():
                         ex.abort()
+                    if adaptive is not None:
+                        adaptive.abort()
             ingest = collect_scan_stats(pipelines) if pipelines else None
             if ingest is not None:
                 annotate_scan_span(sp, ingest)

@@ -37,10 +37,20 @@ class OutputBuffer:
         self._cv = threading.Condition()
         self.pages_enqueued = 0
         self.rows_enqueued = 0
+        # cumulative (never decremented on ack) — the adaptive scheduler's
+        # observed-output counter for activation barriers and join-
+        # distribution decisions
+        self.bytes_enqueued = 0
 
-    def enqueue(self, partition: int, batch: ColumnBatch) -> None:
+    def enqueue(self, partition: int, batch: ColumnBatch,
+                block: bool = True) -> None:
+        """``block=False`` skips the backpressure wait (time-sharing mode:
+        the sink's driver parks via ``needs_input`` instead of pinning its
+        executor worker here; at most one batch's partitions overshoot the
+        byte budget between capacity checks)."""
         with self._cv:
-            while (self._bytes > self.max_bytes and not self._aborted):
+            while (block and self._bytes > self.max_bytes
+                   and not self._aborted):
                 self._cv.wait(timeout=0.5)
             if self._aborted:
                 return
@@ -49,12 +59,23 @@ class OutputBuffer:
             self.pages_enqueued += 1
             # wire relays enqueue SerializedPage, which carries no row count
             self.rows_enqueued += getattr(batch, "num_rows", 0)
+            self.bytes_enqueued += batch.nbytes
             self._cv.notify_all()
+
+    def has_capacity(self) -> bool:
+        """True while the byte budget admits another page (the non-blocking
+        sink's park predicate; only consumer acks can turn this back on)."""
+        with self._cv:
+            return self._aborted or self._bytes <= self.max_bytes
 
     def set_finished(self) -> None:
         with self._cv:
             self._finished = True
             self._cv.notify_all()
+
+    @property
+    def finished(self) -> bool:
+        return self._finished
 
     def abort(self) -> None:
         with self._cv:

@@ -30,7 +30,7 @@ from ..planner.plan import (
 from ..sql.ir import InputRef, referenced_inputs
 
 __all__ = ["PlanFragment", "SubPlan", "FusedSeam", "fragment_plan",
-           "mark_device_residency"]
+           "mark_device_residency", "split_probe_fragment"]
 
 # Aggregate functions whose PARTIAL state merges with plain
 # sum/min/max combines inside one jitted program (avg rides as its
@@ -71,6 +71,12 @@ class PlanFragment:
     device_resident: bool = False   # every operator keeps batches on device
     fused_seam: Optional[FusedSeam] = None  # set when this fragment's
     #                                 REPARTITION edge is whole-stage fusable
+    sink_coalesce_rows: int = 0     # >0: the output sink buffers each
+    #                                 partition's slivers into pages of
+    #                                 about this many rows (adaptive
+    #                                 re-fragmented stages set this; one
+    #                                 join-probe dispatch per sliver is
+    #                                 what it avoids)
 
 
 @dataclass
@@ -253,6 +259,33 @@ def mark_device_residency(subplan: SubPlan) -> SubPlan:
             if seam is not None:
                 producer.fused_seam = seam
     return subplan
+
+
+def split_probe_fragment(consumer: PlanFragment, join,
+                         new_fid: int) -> PlanFragment:
+    """Runtime broadcast->partitioned re-fragmentation (adaptive plane):
+    cut ``join.left`` (the probe subtree) out of the not-yet-activated
+    ``consumer`` fragment into a new REPARTITION fragment hashing on the
+    join's probe keys, and re-enter it as a RemoteSource.  RemoteSources
+    inside the subtree move with it: their producer fragments now feed the
+    new fragment.  ``consumer`` is mutated in place (runtime fragments are
+    per-execution copies, never plan-cache residents)."""
+    from ..planner.add_exchanges import rewrite_join_distribution
+
+    subtree = join.left
+    moved = [n.fragment_id for n in _walk(subtree)
+             if isinstance(n, RemoteSource)]
+    new_frag = PlanFragment(
+        new_fid, subtree, _Fragmenter._partitioning(subtree),
+        "REPARTITION", tuple(join.left_keys), moved)
+    rs = RemoteSource(subtree.output_names, subtree.output_types,
+                      new_fid, "REPARTITION", ())
+    consumer.root = rewrite_join_distribution(
+        consumer.root, join, "PARTITIONED", new_left=rs)
+    consumer.source_fragments = [
+        s for s in consumer.source_fragments if s not in moved] + [new_fid]
+    consumer.partitioning = _Fragmenter._partitioning(consumer.root)
+    return new_frag
 
 
 def fragment_plan(root: PlanNode) -> SubPlan:

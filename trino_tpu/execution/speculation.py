@@ -123,10 +123,13 @@ class GatedBuffer:
     def aborted(self) -> bool:
         return self._inner.aborted
 
-    def enqueue(self, partition: int, batch) -> None:
+    def enqueue(self, partition: int, batch, **kw) -> None:
         if not self._gate.claim(self.kind):
             raise SpeculationLost(self.kind)
-        self._inner.enqueue(partition, batch)
+        self._inner.enqueue(partition, batch, **kw)
+
+    def has_capacity(self) -> bool:
+        return self._inner.has_capacity()
 
     def set_finished(self) -> None:
         # an empty output commits here: first to FINISH an empty stream wins
@@ -282,7 +285,8 @@ class ClusterBlacklist:
 
     def __init__(self, ttl_s: Optional[float] = None,
                  threshold: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 persist: bool = False):
         if ttl_s is None:
             ttl_s = float(os.environ.get("TRINO_TPU_BLACKLIST_TTL_S", "300"))
         if threshold is None:
@@ -291,9 +295,14 @@ class ClusterBlacklist:
         self.ttl_s = float(ttl_s)
         self.threshold = max(1.0, float(threshold))
         self._clock = clock
+        # persist=False keeps unit tests with fake clocks from polluting
+        # (or being polluted by) the process journal
+        self._persist = persist
         self._lock = threading.Lock()
         # worker -> list of (monotonic ts, weight, reason)
         self._entries: dict[str, list[tuple[float, float, str]]] = {}
+        if persist:
+            self.seed_from_journal()
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.ttl_s
@@ -305,7 +314,7 @@ class ClusterBlacklist:
                 del self._entries[w]
 
     def record_failure(self, worker: str, reason: str = "",
-                       weight: float = 1.0) -> float:
+                       weight: float = 1.0, query_id: str = "") -> float:
         now = self._clock()
         with self._lock:
             self._prune_locked(now)
@@ -313,7 +322,61 @@ class ClusterBlacklist:
                 (now, float(weight), reason))
             score = sum(e[1] for e in self._entries[worker])
         self._refresh_gauge()
+        if self._persist:
+            self._journal_entry(worker, weight, reason, query_id)
         return score
+
+    # ----------------------------------------------------------- durability
+    def _journal_entry(self, worker: str, weight: float, reason: str,
+                       query_id: str) -> None:
+        """Append the failure to the durable query journal so a restarted
+        coordinator re-seeds the blacklist instead of handing the flaky
+        worker one task from every post-restart query."""
+        from ..telemetry import journal as tj
+
+        j = tj.get_journal()
+        if j is None:
+            return
+        j._write({
+            "schema": tj.SCHEMA_VERSION,
+            "event": "blacklist_entry",
+            "ts": time.time(),  # wall clock: must survive process restarts
+            "query_id": query_id,
+            "worker": worker,
+            "weight": float(weight),
+            "reason": reason,
+        })
+
+    def seed_from_journal(self) -> int:
+        """Boot-time re-seed with TTL decay: journal entries younger than
+        ``ttl_s`` (by wall clock) re-enter the in-memory table back-dated on
+        this blacklist's monotonic clock, so they expire at the same wall
+        moment they would have without the restart.  Returns entries kept."""
+        from ..telemetry import journal as tj
+
+        j = tj.get_journal()
+        if j is None:
+            return 0
+        now_wall = time.time()
+        now = self._clock()
+        kept = 0
+        with self._lock:
+            for rec in j.read(events=("blacklist_entry",)):
+                try:
+                    age = now_wall - float(rec["ts"])
+                    worker = rec["worker"]
+                    weight = float(rec.get("weight", 1.0))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if not 0 <= age < self.ttl_s:
+                    continue
+                self._entries.setdefault(worker, []).append(
+                    (now - age, weight, str(rec.get("reason", ""))))
+                kept += 1
+            self._prune_locked(now)
+        if kept:
+            self._refresh_gauge()
+        return kept
 
     def score(self, worker: str) -> float:
         now = self._clock()

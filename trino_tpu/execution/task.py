@@ -242,13 +242,47 @@ class PartitionedOutputSink(Operator):
     """Routes task output into the OutputBuffer: REPARTITION hashes on the
     output keys, BROADCAST replicates, GATHER/OUTPUT lands in partition 0."""
 
+    # blocking=True: wait inside OutputBuffer.enqueue when the byte budget
+    # is exhausted (thread-per-task mode).  The time-sharing executor flips
+    # this off; the sink then refuses input via ``needs_input`` and its
+    # driver parks until consumer acks free capacity — quantum-pinning is
+    # never traded for unbounded buffer growth.
+    blocking = True
+
     def __init__(self, buffer: OutputBuffer, kind: str,
-                 keys: Sequence[int] = (), serde: bool = False):
+                 keys: Sequence[int] = (), serde: bool = False,
+                 sketch=None, sketch_keys: Sequence[int] = (),
+                 coalesce_rows: int = 0):
         self.buffer = buffer
         self.kind = kind
         self.keys = list(keys)
         self.serde = serde  # serialize pages to wire bytes (network mode)
         self._rr = 0  # ROUND_ROBIN rotation cursor
+        # adaptive deferred edges: a HeavyHitterSketch fed the join-key
+        # hashes of every row so the coordinator can fold per-task key
+        # distributions at the consumer's activation barrier
+        self.sketch = sketch
+        self.sketch_keys = list(sketch_keys)
+        # >0: REPARTITION buffers each partition's slivers and releases
+        # ~coalesce_rows-row pages — a page split n ways otherwise hands
+        # the consumer one operator dispatch per sliver
+        self.coalesce_rows = coalesce_rows
+        self._pend: dict[int, list] = {}  # partition -> [rows, [slivers]]
+
+    def needs_input(self) -> bool:
+        if (not self.blocking and hasattr(self.buffer, "has_capacity")
+                and not self.buffer.has_capacity()):
+            return False
+        return super().needs_input()
+
+    def _enqueue(self, partition: int, page) -> None:
+        # block= is only passed on the non-blocking path: FTE wraps a
+        # DurableSpoolWriter in this sink, whose enqueue has no such kwarg
+        # (and is never flipped non-blocking — FTE bypasses the executor)
+        if self.blocking:
+            self.buffer.enqueue(partition, page)
+        else:
+            self.buffer.enqueue(partition, page, block=False)
 
     def _page(self, batch: ColumnBatch):
         if self.serde:
@@ -260,6 +294,11 @@ class PartitionedOutputSink(Operator):
         batch = batch.compact()
         if batch.num_rows == 0:
             return
+        if self.sketch is not None and self.sketch_keys:
+            h = K.partition_key_hashes(
+                [_partition_key_tuple(batch.columns[k])
+                 for k in self.sketch_keys])
+            self.sketch.update(h)
         n = self.buffer.num_partitions
         if self.kind == "REPARTITION" and n > 1:
             cols = [batch.columns[k] for k in self.keys]
@@ -267,22 +306,42 @@ class PartitionedOutputSink(Operator):
                 [_partition_key_tuple(c) for c in cols], n)
             for p in range(n):
                 sub = batch.filter(parts == p)
-                if sub.num_rows:
-                    self.buffer.enqueue(p, self._page(sub))
+                if not sub.num_rows:
+                    continue
+                if self.coalesce_rows:
+                    self._buffer_sliver(p, sub)
+                else:
+                    self._enqueue(p, self._page(sub))
         elif self.kind == "BROADCAST" and n > 1:
             page = self._page(batch)
             for p in range(n):
-                self.buffer.enqueue(p, page)
+                self._enqueue(p, page)
         elif self.kind == "ROUND_ROBIN" and n > 1:
             # batch-granular rotation (RandomExchanger / ArbitraryOutputBuffer
             # role: balance load without any key)
-            self.buffer.enqueue(self._rr % n, self._page(batch))
+            self._enqueue(self._rr % n, self._page(batch))
             self._rr += 1
         else:
-            self.buffer.enqueue(0, self._page(batch))
+            self._enqueue(0, self._page(batch))
+
+    def _buffer_sliver(self, p: int, sub: ColumnBatch) -> None:
+        ent = self._pend.get(p)
+        if ent is None:
+            ent = self._pend[p] = [0, []]
+        ent[0] += sub.num_rows
+        ent[1].append(sub)
+        if ent[0] >= self.coalesce_rows:
+            self._flush_pending(p)
+
+    def _flush_pending(self, p: int) -> None:
+        ent = self._pend.pop(p, None)
+        if ent is not None and ent[1]:
+            self._enqueue(p, self._page(ColumnBatch.concat(ent[1])))
 
     def finish_input(self) -> None:
         super().finish_input()
+        for p in list(self._pend):
+            self._flush_pending(p)
         self.buffer.set_finished()
 
     def is_finished(self) -> bool:

@@ -53,7 +53,8 @@ from .plan import (
     Window,
 )
 
-__all__ = ["add_exchanges", "partial_agg_layout"]
+__all__ = ["add_exchanges", "partial_agg_layout",
+           "rewrite_join_distribution"]
 
 
 def partial_agg_layout(aggs, input_types) -> list[tuple[str, Type, int]]:
@@ -88,6 +89,42 @@ def add_exchanges(root: PlanNode, writer_tasks: int = 1) -> PlanNode:
 def _exchange(node: PlanNode, kind: str, keys=()) -> Exchange:
     return Exchange(node.output_names, node.output_types, node, kind,
                     "REMOTE", tuple(keys))
+
+
+def rewrite_join_distribution(root: PlanNode, join: Join,
+                              new_distribution: str,
+                              new_left: Optional[PlanNode] = None
+                              ) -> PlanNode:
+    """Runtime PARTITIONED<->REPLICATED rewrite used by the adaptive
+    execution plane (execution/adaptive.py): return ``root`` with the
+    exact node ``join`` (identity match) replaced by a copy carrying
+    ``new_distribution`` (and, for a broadcast->partitioned flip,
+    ``new_left`` — the probe subtree cut into its own fragment and
+    re-entered as a RemoteSource).  Only legal on plan trees whose
+    consuming stage has not been activated yet; the static planning path
+    never calls this."""
+    from dataclasses import replace as _replace
+
+    def walk(node: PlanNode) -> PlanNode:
+        if node is join:
+            return _replace(node,
+                            left=node.left if new_left is None else new_left,
+                            distribution=new_distribution)
+        kids = node.children
+        if not kids:
+            return node
+        new_kids = [walk(c) for c in kids]
+        if all(a is b for a, b in zip(kids, new_kids)):
+            return node
+        if isinstance(node, Union):
+            return _replace(node, sources=tuple(new_kids))
+        if len(kids) == 1:
+            return _replace(node, source=new_kids[0])
+        if hasattr(node, "left"):
+            return _replace(node, left=new_kids[0], right=new_kids[1])
+        return _replace(node, source=new_kids[0], filter_source=new_kids[1])
+
+    return walk(root)
 
 
 def _visit(node: PlanNode, single: bool, writer_tasks: int = 1) -> PlanNode:

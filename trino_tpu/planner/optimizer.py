@@ -433,7 +433,14 @@ def _choose_distribution(build: PlanNode, catalog: Catalog,
     # right/full joins cannot use REPLICATED)
     if join_type in ("RIGHT", "FULL"):
         return "PARTITIONED"
-    return ("BROADCAST" if estimate_rows(build, catalog) <= _BROADCAST_LIMIT
+    import os
+
+    # override hook for mis-estimation drills: force a wrong static choice
+    # and let the adaptive plane (execution/adaptive.py) correct it at the
+    # activation barrier from OBSERVED bytes
+    limit = int(os.environ.get("TRINO_TPU_BROADCAST_ROW_LIMIT",
+                               str(_BROADCAST_LIMIT)) or _BROADCAST_LIMIT)
+    return ("BROADCAST" if estimate_rows(build, catalog) <= limit
             else "PARTITIONED")
 
 

@@ -107,6 +107,7 @@ SETTABLE_SESSION_PROPERTIES = {
     "exchange_backoff_max_s", "exchange_max_failure_duration_s",
     "speculation", "speculation_lag_multiplier", "speculation_min_delay_s",
     "blacklist_ttl_s", "blacklist_threshold", "drain_timeout_s",
+    "adaptive", "broadcast_threshold_bytes", "skew_factor",
 }
 
 
@@ -517,6 +518,13 @@ class Session:
     # coordinator-driven graceful drain budget (None = the
     # TRINO_TPU_DRAIN_TIMEOUT_S env knob, default 30s coordinator-side)
     drain_timeout_s: object = None
+    # adaptive execution (execution/adaptive.py): tri-state None defers to
+    # TRINO_TPU_ADAPTIVE ("auto" default; "0" is bit-for-bit legacy, "1"
+    # forces the phased scheduler); 0 thresholds defer to
+    # TRINO_TPU_BROADCAST_THRESHOLD_BYTES / TRINO_TPU_SKEW_FACTOR
+    adaptive: object = None
+    broadcast_threshold_bytes: int = 0
+    skew_factor: float = 0.0
     # INSERT/CTAS fan out over round-robin writer tasks when the source is
     # large (SCALED_WRITER_* partitionings in miniature; planned by estimate)
     scale_writers: bool = False

@@ -115,7 +115,16 @@ def sample_records() -> list[dict]:
         "q_sample2", "SELECT 1/0", state="FAILED", user="lint",
         error="DIVISION_BY_ZERO: division by zero",
         error_code="DIVISION_BY_ZERO"))
-    return [created, ok, failed]
+    blacklist = {
+        "schema": SCHEMA_VERSION,
+        "event": "blacklist_entry",
+        "ts": 1700000000.0,
+        "query_id": "q_sample2",
+        "worker": "worker-1",
+        "weight": 1.0,
+        "reason": "INTERNAL: injected task failure",
+    }
+    return [created, ok, failed, blacklist]
 
 
 class QueryJournal(EventListener):

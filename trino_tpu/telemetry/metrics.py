@@ -35,7 +35,8 @@ from typing import Optional
 __all__ = [
     "Counter", "Gauge", "Distribution", "MetricsRegistry", "REGISTRY",
     "observe_scan", "observe_sync", "observe_resilience", "observe_fused",
-    "observe_exchange", "update_device_memory_watermark",
+    "observe_exchange", "observe_adaptive",
+    "update_device_memory_watermark",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -526,6 +527,31 @@ CACHE_RESULT_ENTRIES = REGISTRY.gauge(
 CACHE_RESULT_BYTES = REGISTRY.gauge(
     "trino_cache_result_bytes", "result cache resident bytes")
 
+# adaptive execution plane (execution/adaptive.py): phased activation,
+# runtime join-distribution switching, skew-aware repartitioning
+ADAPTIVE_DECISIONS = REGISTRY.counter(
+    "trino_adaptive_decisions_total",
+    "adaptive decision points evaluated at stage activation barriers")
+ADAPTIVE_BROADCAST_FLIPS = REGISTRY.counter(
+    "trino_adaptive_flips_to_broadcast_total",
+    "partitioned joins flipped to broadcast on observed build size")
+ADAPTIVE_PARTITION_FLIPS = REGISTRY.counter(
+    "trino_adaptive_flips_to_partitioned_total",
+    "broadcast joins flipped to partitioned on observed build size")
+ADAPTIVE_SKEW_SPLITS = REGISTRY.counter(
+    "trino_adaptive_skew_splits_total",
+    "heavy-hitter keys split across multiple probe tasks")
+ADAPTIVE_STAGE_ACTIVATIONS = REGISTRY.counter(
+    "trino_adaptive_stage_activations_total",
+    "stages activated by the phased bottom-up scheduler")
+ADAPTIVE_MEMO_HITS = REGISTRY.counter(
+    "trino_adaptive_memo_hits_total",
+    "adaptive decisions replayed from the runtime-stat-keyed memo")
+ADAPTIVE_SKEW_IMBALANCE = REGISTRY.gauge(
+    "trino_adaptive_skew_imbalance_ratio",
+    "sketch-estimated max partition weight before the last skew split "
+    "divided by after; the load-balance win a parallel host realises")
+
 
 # ------------------------------------------------------------ observe hooks
 def resource_group_gauges(path: str):
@@ -608,6 +634,16 @@ def observe_exchange(nbytes: int, pages: int, wait_s: float) -> None:
     EXCHANGE_BYTES.inc(nbytes)
     EXCHANGE_PAGES.inc(pages)
     EXCHANGE_WAIT_SECONDS.inc(wait_s)
+
+
+def observe_adaptive(st) -> None:
+    """Fold an AdaptiveStats roll-up (exec/stats.py).  ``decisions`` and the
+    per-kind counters are recorded at decision time by execution/adaptive.py;
+    here only the per-query activation count folds in, so a re-run of the
+    same query never double-counts flips."""
+    if st is None or not st.any:
+        return
+    ADAPTIVE_STAGE_ACTIVATIONS.inc(st.activations)
 
 
 def update_device_memory_watermark() -> Optional[int]:

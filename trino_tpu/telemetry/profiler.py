@@ -43,7 +43,8 @@ from typing import Optional
 
 __all__ = [
     "OPERATOR", "FUSED", "EXCHANGE", "STAGE", "SPILL", "SPECULATION",
-    "TASK", "level", "enabled", "is_full", "set_level", "event", "instant",
+    "TASK", "ADAPTIVE",
+    "level", "enabled", "is_full", "set_level", "event", "instant",
     "now", "set_context", "capture_context", "apply_context", "sync_batch",
     "collect", "harvest", "add_remote_events", "take_task_events",
     "events_for", "chrome_trace", "reset_for_test",
@@ -57,6 +58,7 @@ STAGE = "batch-staged"
 SPILL = "spill"
 SPECULATION = "speculation"
 TASK = "task"
+ADAPTIVE = "adaptive"
 
 _OFF, _DEFAULT, _FULL = 0, 1, 2
 

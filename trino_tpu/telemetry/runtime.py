@@ -23,7 +23,8 @@ from typing import Optional
 
 __all__ = [
     "QueryRecord", "TaskRecord", "query_started", "query_finished",
-    "current_record", "add_input", "add_retries", "task_started",
+    "current_record", "add_input", "add_retries", "add_adaptive",
+    "task_started",
     "task_finished", "queries", "tasks", "fingerprint",
 ]
 
@@ -41,7 +42,8 @@ class QueryRecord:
                  "end_time", "wall_ms", "cpu_ms", "output_rows", "error",
                  "input_rows", "input_bytes", "retry_count",
                  "peak_memory_bytes", "fingerprint", "queued_ms",
-                 "resource_group", "speculative_wins", "_lock")
+                 "resource_group", "speculative_wins", "adaptive_decisions",
+                 "_lock")
 
     def __init__(self, query_id: str, sql: str, user: str):
         self.query_id = query_id
@@ -62,6 +64,9 @@ class QueryRecord:
         self.queued_ms = 0.0
         self.resource_group = ""
         self.speculative_wins = 0
+        # compact "kind[site]=choice" list, comma-joined — the
+        # system.runtime.queries adaptive_decisions column
+        self.adaptive_decisions = ""
         self._lock = threading.Lock()
 
 
@@ -132,6 +137,16 @@ def add_retries(rec: Optional[QueryRecord], n: int) -> None:
         return
     with rec._lock:
         rec.retry_count += int(n)
+
+
+def add_adaptive(rec: Optional[QueryRecord], decision: str) -> None:
+    """Append one adaptive-execution decision tag to the query record."""
+    if rec is None or not decision:
+        return
+    with rec._lock:
+        rec.adaptive_decisions = (
+            decision if not rec.adaptive_decisions
+            else rec.adaptive_decisions + "," + decision)
 
 
 def task_started(query_id: str, task_id: str, fragment: int,

@@ -31,6 +31,15 @@ def test_decimal_division_by_zero_raises(runner):
             "select o_totalprice / (o_totalprice - o_totalprice) from orders")
 
 
+def test_explain_of_failing_query_plans_without_evaluating(runner):
+    # EXPLAIN never runs the lanes, so a query whose execution raises
+    # DIVISION_BY_ZERO still yields a plan
+    rows = runner.execute(
+        "explain select o_totalprice / (o_totalprice - o_totalprice) "
+        "from orders").rows()
+    assert rows and any("orders" in str(r[0]) for r in rows)
+
+
 def test_modulus_by_zero_raises(runner):
     with pytest.raises(Exception, match="DIVISION_BY_ZERO"):
         runner.execute("select 7 % 0")

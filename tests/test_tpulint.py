@@ -1,0 +1,536 @@
+"""tpulint framework tests: per-rule fixtures plus the whole-repo smoke.
+
+Each rule gets positive (fires), negative (stays quiet), suppressed, and
+unused-suppression coverage over tiny fixture trees written to tmp_path and
+indexed by the same ProjectIndex the real run uses — so every assertion
+exercises the production parse/symbol/callgraph core, not a mock.  The
+smoke test at the bottom runs the full pipeline over the real repo and
+pins the committed baseline: a new finding, a stale baseline entry, or a
+stale suppression anywhere in the tree fails tier-1.
+
+Directive and knob literals inside fixture sources are assembled by
+concatenation so this file's own source stays invisible to the repo-wide
+suppression and knob-registry scans.
+"""
+
+import json
+import subprocess
+import sys
+
+from tools.analysis import baseline as bl
+from tools.analysis import knobdocs, repo_root, run_analysis
+from tools.analysis.core import ProjectIndex, apply_suppressions
+from tools.analysis.rules import all_rules, knob_registry
+
+RULES = {r.name: r for r in all_rules()}
+
+# assembled at runtime so the scans never see a live directive / knob name
+# in this file's source
+D = "# tpulint" + ": disable"            # -> "# tpulint: disable"
+DF = "# tpulint" + ": disable-file"
+KNOB_GOOD = "TRINO_TPU_" + "FIXTURE_LANES"
+KNOB_BAD = "TRINO_TPU_" + "FIXTURE_LANSE"    # the typo the rule must catch
+
+
+def project(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return ProjectIndex.build(str(tmp_path))
+
+
+def findings_of(rule, index):
+    return RULES[rule].check(index)
+
+
+# --------------------------------------------------- host-sync (dataflow)
+
+HOT_FLOW = """\
+import jax.numpy as jnp
+import numpy as np
+from .syncguard import SG
+
+def hot(x):
+    with SG.hot_region():
+        y = jnp.ones(3)
+        n = int(y)
+        return helper(x) + n
+
+def helper(x):
+    total = jnp.sum(x)
+    flag = bool(total)
+    if total:
+        return flag
+    host = np.asarray(total)
+    return host
+
+def cold(x):
+    total = jnp.sum(x)
+    return bool(total)
+"""
+
+
+def test_host_sync_dataflow_flags_implicit_syncs(tmp_path):
+    idx = project(tmp_path, {"trino_tpu/exec/flow.py": HOT_FLOW})
+    found = findings_of("host-sync", idx)
+    msgs = [f.message for f in found]
+    # inside the hot region itself
+    assert any("int() on a device value" in m for m in msgs)
+    # in a function reachable from the region via the callgraph
+    assert any("bool() on a device value" in m for m in msgs)
+    assert any("truthiness of a device value in 'if'" in m for m in msgs)
+    assert any("np.asarray() on a device value" in m for m in msgs)
+    # none of these are raw sync spellings: the old grep finds zero here
+    from tools.analysis.rules.host_sync import lint_file
+    assert lint_file(str(tmp_path / "trino_tpu/exec/flow.py")) == []
+
+
+def test_host_sync_dataflow_ignores_unreachable_cold_code(tmp_path):
+    idx = project(tmp_path, {"trino_tpu/exec/flow.py": HOT_FLOW})
+    found = findings_of("host-sync", idx)
+    # cold() truthiness-tests a device value but is not reachable from any
+    # hot region — it must stay quiet
+    assert all(f.snippet != "return bool(total)" for f in found)
+
+
+def test_host_sync_dataflow_without_hot_region_is_quiet(tmp_path):
+    quiet = HOT_FLOW.replace("with SG.hot_region():", "if True:")
+    idx = project(tmp_path, {"trino_tpu/exec/flow.py": quiet})
+    assert findings_of("host-sync", idx) == []
+
+
+def test_host_sync_pattern_layer_and_pragma(tmp_path):
+    src = ("def take(buf):\n"
+           "    a = buf.pop().item()\n"
+           "    b = buf.pop().item()  # sync" + "-ok: drained after barrier\n"
+           "    return a + b\n")
+    idx = project(tmp_path, {"trino_tpu/exec/take.py": src})
+    found = findings_of("host-sync", idx)
+    assert len(found) == 1 and ".item() blocking sync" in found[0].message
+    assert found[0].line == 2
+
+
+def test_host_sync_directive_suppression(tmp_path):
+    src = HOT_FLOW.replace(
+        "    flag = bool(total)",
+        f"    flag = bool(total)  {D}=host-sync -- fixture: cold fallback")
+    idx = project(tmp_path, {"trino_tpu/exec/flow.py": src})
+    raw = findings_of("host-sync", idx)
+    kept, suppressed = apply_suppressions(idx, raw, {"host-sync"})
+    assert any("bool() on a device value" in f.message for f in suppressed)
+    assert all("bool() on a device value" not in f.message for f in kept)
+
+
+# ----------------------------------------------------------- thread-safety
+
+TS_SHARED = """\
+import threading
+
+class Buf:
+    def __init__(self, pool):
+        self._pool = pool
+        self._lock = threading.Lock()
+        self._items = []
+        self._free = []
+
+    def start(self):
+        self._pool.submit(self._drain)
+
+    def _drain(self):
+        with self._lock:
+            self._items.append(1)
+
+    def push(self, x):
+        self._items.append(x)
+
+    def note(self, x):
+        self._free.append(x)
+"""
+
+
+def test_thread_safety_flags_unlocked_mutation_of_guarded_attr(tmp_path):
+    idx = project(tmp_path, {"trino_tpu/ts.py": TS_SHARED})
+    found = findings_of("thread-safety", idx)
+    # push() mutates self._items (guarded — _drain locks it) without the
+    # lock; note() touches self._free which is never locked anywhere, so
+    # it is presumed single-threaded and stays quiet
+    assert len(found) == 1
+    f = found[0]
+    assert "unlocked mutation of lock-guarded attribute 'self._items'" \
+        in f.message
+    assert "'Buf'" in f.message and "_drain" in f.message
+    assert f.snippet == "self._items.append(x)"
+
+
+def test_thread_safety_unshared_class_is_quiet(tmp_path):
+    solo = TS_SHARED.replace("        self._pool.submit(self._drain)\n", "")
+    idx = project(tmp_path, {"trino_tpu/ts.py": solo})
+    # same locking pattern, but nothing ever hands a method to a thread —
+    # no sharing evidence, no finding
+    assert findings_of("thread-safety", idx) == []
+
+
+def test_thread_safety_external_spawn_counts_as_shared(tmp_path):
+    src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []
+
+    def run(self):
+        with self._lock:
+            self._q.append(0)
+
+    def bump(self):
+        self._q.append(1)
+
+def boot():
+    p = Pump()
+    t = threading.Thread(target=p.run)
+    t.start()
+"""
+    idx = project(tmp_path, {"trino_tpu/pump.py": src})
+    found = findings_of("thread-safety", idx)
+    assert len(found) == 1
+    assert "'self._q'" in found[0].message and "'Pump'" in found[0].message
+
+
+def test_thread_safety_lock_order_cycle(tmp_path):
+    src = """\
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._pool = None
+
+    def start(self):
+        self._pool.submit(self.one)
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    idx = project(tmp_path, {"trino_tpu/ab.py": src})
+    found = [f for f in findings_of("thread-safety", idx)
+             if "lock-order cycle" in f.message]
+    assert len(found) == 1
+    assert "AB._a" in found[0].message and "AB._b" in found[0].message
+    # consistent ordering everywhere: no cycle, no finding
+    fixed = src.replace("        with self._b:\n            with self._a:",
+                        "        with self._a:\n            with self._b:")
+    idx2 = project(tmp_path / "fixed", {"trino_tpu/ab.py": fixed})
+    assert not [f for f in findings_of("thread-safety", idx2)
+                if "lock-order cycle" in f.message]
+
+
+def test_thread_safety_directive_suppression(tmp_path):
+    src = TS_SHARED.replace(
+        "        self._items.append(x)\n\n    def note",
+        f"        self._items.append(x)  {D}=thread-safety -- fixture: "
+        "callers hold the lock\n\n    def note")
+    idx = project(tmp_path, {"trino_tpu/ts.py": src})
+    raw = findings_of("thread-safety", idx)
+    kept, suppressed = apply_suppressions(idx, raw, {"thread-safety"})
+    assert kept == [] and len(suppressed) == 1
+
+
+# ------------------------------------------------- knob-registry/knob-docs
+
+KNOBS_FIXTURE = f"""\
+def Knob(*args, **kwargs):
+    return args
+
+KNOBS = [
+    Knob("{KNOB_GOOD}", "int", "8", "fixture lanes per step"),
+]
+"""
+
+
+def test_knob_registry_flags_undeclared_literal(tmp_path):
+    use = (f'import os\n\n'
+           f'GOOD = os.environ.get("{KNOB_GOOD}", "8")\n'
+           f'BAD = os.environ.get("{KNOB_BAD}", "")\n')
+    idx = project(tmp_path, {"trino_tpu/spi/knobs.py": KNOBS_FIXTURE,
+                             "trino_tpu/cfg.py": use})
+    found = findings_of("knob-registry", idx)
+    assert len(found) == 1
+    assert found[0].path == "trino_tpu/cfg.py" and found[0].line == 4
+    assert KNOB_BAD in found[0].message
+    # the typo hint points at the nearest declared name
+    assert KNOB_GOOD in found[0].message
+
+
+def test_knob_registry_missing_registry_is_a_finding(tmp_path):
+    idx = project(tmp_path, {"trino_tpu/cfg.py": "X = 1\n"})
+    found = findings_of("knob-registry", idx)
+    assert len(found) == 1
+    assert "knob registry missing or unreadable" in found[0].message
+
+
+def test_knob_docs_missing_stale_fresh(tmp_path):
+    idx = project(tmp_path, {"trino_tpu/spi/knobs.py": KNOBS_FIXTURE})
+    missing = knob_registry.check_docs(idx)
+    assert len(missing) == 1 and "docs/KNOBS.md missing" in missing[0].message
+
+    knobdocs.write(str(tmp_path))
+    assert knob_registry.check_docs(idx) == []
+
+    docs = tmp_path / "docs" / "KNOBS.md"
+    docs.write_text(docs.read_text() + "hand edit\n")
+    stale = knob_registry.check_docs(idx)
+    assert len(stale) == 1 and "stale vs the registry" in stale[0].message
+
+
+# ----------------------------------------------------------- error-taxonomy
+
+TAXONOMY_FIXTURE = """\
+def risky(g):
+    try:
+        g()
+    except:
+        pass
+    try:
+        g()
+    except Exception:
+        pass
+    raise RuntimeError("boom")
+
+def fine(g):
+    try:
+        g()
+    except FileNotFoundError:
+        pass
+    try:
+        g()
+    except Exception as e:
+        g(e)
+    raise NotImplementedError("feature gap")
+"""
+
+
+def test_error_taxonomy_flags_bare_blind_and_generic(tmp_path):
+    idx = project(tmp_path,
+                  {"trino_tpu/execution/bad.py": TAXONOMY_FIXTURE})
+    found = findings_of("error-taxonomy", idx)
+    assert len(found) == 3
+    msgs = sorted(f.message for f in found)
+    assert any("bare 'except:'" in m for m in msgs)
+    assert any("blind 'except Exception: pass'" in m for m in msgs)
+    assert any("raise RuntimeError on the query path" in m for m in msgs)
+    # everything in fine() — narrow swallow, handled broad catch,
+    # NotImplementedError — stays legal
+    assert all(f.line <= 10 for f in found)
+
+
+def test_error_taxonomy_scope_is_the_query_path(tmp_path):
+    # the same code outside execution// exec/ is out of contract
+    idx = project(tmp_path,
+                  {"trino_tpu/connectors/bad.py": TAXONOMY_FIXTURE})
+    assert findings_of("error-taxonomy", idx) == []
+
+
+# ------------------------------------- suppression + baseline mechanics
+
+def _run(tmp_path, **kw):
+    return run_analysis(root=str(tmp_path), rule_names=["error-taxonomy"],
+                        baseline_path=str(tmp_path / "bl.json"), **kw)
+
+
+def test_suppression_same_line_and_own_line(tmp_path):
+    src = (f'def a():\n'
+           f'    raise RuntimeError("x")  {D}=error-taxonomy -- fixture: '
+           f'same-line\n'
+           f'\n'
+           f'def b():\n'
+           f'    {D}=error-taxonomy -- fixture: own-line\n'
+           f'    raise ValueError("y")\n')
+    project(tmp_path, {"trino_tpu/execution/sup.py": src})
+    rep = _run(tmp_path)
+    assert rep.clean
+    assert len(rep.suppressed) == 2 and not rep.findings
+
+
+def test_suppression_file_scope(tmp_path):
+    src = (f'{DF}=error-taxonomy -- fixture: generated file\n'
+           f'def a():\n'
+           f'    raise RuntimeError("x")\n'
+           f'def b():\n'
+           f'    raise ValueError("y")\n')
+    project(tmp_path, {"trino_tpu/execution/gen.py": src})
+    rep = _run(tmp_path)
+    assert rep.clean and len(rep.suppressed) == 2
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    src = (f'{D}=error-taxonomy -- fixture: excuses nothing\n'
+           f'def ok():\n'
+           f'    return 1\n')
+    project(tmp_path, {"trino_tpu/execution/sup.py": src})
+    rep = _run(tmp_path)
+    assert not rep.clean
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert f.rule == "unused-suppression" and "matches no finding" in f.message
+
+
+def test_baseline_is_exact_not_a_ratchet(tmp_path):
+    mod = tmp_path / "trino_tpu" / "execution" / "base.py"
+    project(tmp_path, {"trino_tpu/execution/base.py":
+                       'def f():\n    raise RuntimeError("grandfathered")\n'})
+    rep1 = _run(tmp_path)
+    assert len(rep1.findings) == 1 and not rep1.baselined
+
+    # grandfather it: the identical run is clean and accounted baselined
+    bl.write(rep1.findings, str(tmp_path / "bl.json"))
+    rep2 = _run(tmp_path)
+    assert rep2.clean and len(rep2.baselined) == 1
+
+    # a second identical violation exceeds the baselined multiplicity
+    mod.write_text('def f():\n    raise RuntimeError("grandfathered")\n'
+                   'def g():\n    raise RuntimeError("grandfathered")\n')
+    rep3 = _run(tmp_path)
+    assert len(rep3.findings) == 1 and len(rep3.baselined) == 1
+
+    # fixing the violation while the entry lingers turns the entry stale —
+    # the baseline must shrink with the code, not outlive it
+    mod.write_text("def f():\n    return 0\n")
+    rep4 = _run(tmp_path)
+    assert not rep4.findings and rep4.stale_baseline and not rep4.clean
+
+
+# ----------------------------------------------- migrated rules (AST wins)
+
+def test_net_timeout_sees_multiline_and_positional(tmp_path):
+    src = """\
+from urllib.request import urlopen
+
+def fetch(url, data):
+    return urlopen(
+        url,
+        data,
+    )
+
+def fetch_pos(url, data):
+    return urlopen(url, data, 5.0)
+
+def fetch_kw(url):
+    return urlopen(url, timeout=1.0)
+"""
+    idx = project(tmp_path, {"trino_tpu/execution/net.py": src})
+    found = findings_of("net-timeout", idx)
+    # only the multi-line call without a timeout fires — the grep-era lint
+    # could never see across the line break; positional timeouts count
+    assert len(found) == 1
+    assert found[0].message == "urlopen without timeout"
+    assert found[0].line == 4
+
+
+def test_cache_bounds_flags_unbounded_exempts_registry(tmp_path):
+    src = """\
+import functools
+
+@functools.lru_cache
+def memo(x):
+    return x
+
+@functools.lru_cache(maxsize=128)
+def bounded(x):
+    return x
+"""
+    idx = project(tmp_path, {
+        "trino_tpu/util/memo.py": src,
+        "trino_tpu/caching/executable_cache.py": src,  # sanctioned fallback
+    })
+    found = findings_of("cache-bounds", idx)
+    assert [f.path for f in found] == ["trino_tpu/util/memo.py"]
+    assert "unbounded memo cache" in found[0].message
+
+
+def test_metric_names_framework_checks(tmp_path):
+    src = """\
+def setup(reg):
+    reg.counter("trino_fixture_events_total", "doc")
+    reg.counter("bad-name", "doc")
+    reg.counter("trino_fixture_drops", "doc")
+    reg.gauge("trino_fixture_depth", "doc")
+    reg.gauge("trino_fixture_depth", "doc")
+"""
+    idx = project(tmp_path, {"trino_tpu/telemetry/fx.py": src})
+    found = findings_of("metric-names", idx)
+    local = [f for f in found if f.path == "trino_tpu/telemetry/fx.py"]
+    msgs = sorted(f.message for f in local)
+    assert len(local) == 3
+    assert any("illegal Prometheus metric name" in m for m in msgs)
+    assert any("must end in '_total'" in m for m in msgs)
+    assert any("duplicate registration" in m for m in msgs)
+    # the fixture tree has none of the contractual families — the
+    # completeness check must notice
+    assert any("trino_profile_" in f.message for f in found
+               if f.path == "trino_tpu")
+
+
+def test_hygiene_flags_debug_and_assert_free_modules(tmp_path):
+    idx = project(tmp_path, {
+        "tests/test_dbg_scratchpad.py": "print('hi')\n",
+        "tests/test_quiet.py": "def test_x():\n    print(1)\n",
+        "tests/test_good.py": "def test_y():\n    assert 1\n",
+    })
+    found = {f.path: f.message for f in findings_of("test-hygiene", idx)}
+    assert "debug-leftover test file" in found["tests/test_dbg_scratchpad.py"]
+    assert "no assertions" in found["tests/test_quiet.py"]
+    assert "tests/test_good.py" not in found
+
+
+# ------------------------------------------------------------ CLI + smoke
+
+def test_cli_fixture_roundtrip(tmp_path):
+    (tmp_path / "trino_tpu" / "execution").mkdir(parents=True)
+    (tmp_path / "trino_tpu" / "execution" / "bad.py").write_text(
+        'def f():\n    raise RuntimeError("boom")\n')
+    base = [sys.executable, "-m", "tools.analysis",
+            "--root", str(tmp_path), "--rules", "error-taxonomy",
+            "--baseline", str(tmp_path / "bl.json")]
+    dirty = subprocess.run(base + ["--json"], cwd=repo_root(),
+                           capture_output=True, text=True)
+    assert dirty.returncode == 1, dirty.stderr
+    data = json.loads(dirty.stdout)
+    assert [f["rule"] for f in data["findings"]] == ["error-taxonomy"]
+    assert data["stats"]["clean"] is False
+
+    upd = subprocess.run(base + ["--update-baseline"], cwd=repo_root(),
+                         capture_output=True, text=True)
+    assert upd.returncode == 0, upd.stderr
+    clean = subprocess.run(base + ["--json"], cwd=repo_root(),
+                           capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stderr
+    assert json.loads(clean.stdout)["stats"]["baselined"] == 1
+
+
+def test_repo_is_tpulint_clean():
+    """The tier-1 gate: the whole tree passes every rule, and the committed
+    baseline matches the live run entry-for-entry."""
+    rep = run_analysis()
+    detail = "\n".join(f.format() for f in rep.findings)
+    if rep.stale_baseline:
+        detail += f"\nstale baseline entries: {rep.stale_baseline}"
+    assert rep.clean, f"tpulint violations:\n{detail}"
+    # the full rule set ran over the real tree
+    assert {"host-sync", "thread-safety", "knob-registry", "knob-docs",
+            "error-taxonomy", "net-timeout", "metric-names", "cache-bounds",
+            "journal-schema", "test-hygiene"} <= set(rep.rules_run)
+    assert rep.files_scanned > 100
+    # every committed grandfather entry still fires (exactness), and the
+    # deliberate in-tree exceptions are actually exercised
+    assert len(rep.baselined) == sum(bl.load().values())
+    assert rep.suppressed, "expected at least one used in-tree suppression"

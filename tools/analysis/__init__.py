@@ -1,0 +1,101 @@
+"""tpulint — AST/dataflow static analysis for the trino-tpu engine.
+
+One command (``python -m tools.analysis``), one shared parse/symbol/
+callgraph core (:mod:`tools.analysis.core`), pluggable rules
+(:mod:`tools.analysis.rules`), file/line suppressions with an
+unused-suppression check, and an exact committed baseline
+(:mod:`tools.analysis.baseline`).
+
+Programmatic entry point: :func:`run_analysis`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclass
+class Report:
+    """Everything one analysis run produced, pre-rendered decisions only —
+    the CLI and the tier-1 test both consume this."""
+
+    findings: list            # non-baselined, non-suppressed (the failures)
+    baselined: list           # findings excused by the committed baseline
+    suppressed: list          # findings excused by inline pragmas
+    stale_baseline: list      # (rule, path, message, count) no longer firing
+    rule_counts: dict         # rule -> raw finding count (pre-baseline)
+    rule_seconds: dict        # rule -> wall seconds
+    files_scanned: int
+    wall_seconds: float
+    rules_run: list
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+    def stats(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "rules_run": list(self.rules_run),
+            "rule_counts": dict(sorted(self.rule_counts.items())),
+            "rule_seconds": {k: round(v, 3) for k, v in
+                             sorted(self.rule_seconds.items())},
+            "findings": len(self.findings),
+            "baselined": len(self.baselined),
+            "suppressed": len(self.suppressed),
+            "stale_baseline": len(self.stale_baseline),
+            "clean": self.clean,
+        }
+
+
+def run_analysis(root: str = None, rule_names: list = None,
+                 baseline_path: str = None) -> Report:
+    from . import baseline as bl
+    from .core import ProjectIndex, apply_suppressions
+    from .rules import all_rules
+
+    t0 = time.monotonic()
+    root = root or repo_root()
+    index = ProjectIndex.build(root)
+    rules = all_rules()
+    if rule_names:
+        unknown = set(rule_names) - {r.name for r in rules}
+        if unknown:
+            raise SystemExit(f"unknown rule(s): {', '.join(sorted(unknown))}"
+                             f" (try --list-rules)")
+        rules = [r for r in rules if r.name in rule_names]
+
+    raw, rule_counts, rule_seconds = [], {}, {}
+    for rule in rules:
+        r0 = time.monotonic()
+        out = rule.check(index)
+        rule_seconds[rule.name] = time.monotonic() - r0
+        rule_counts[rule.name] = len(out)
+        raw.extend(out)
+
+    ran = {r.name for r in rules} | {"unused-suppression"}
+    kept, suppressed = apply_suppressions(index, raw, ran)
+    unused = [f for f in kept if f.rule == "unused-suppression"]
+    rule_counts["unused-suppression"] = len(unused)
+
+    base = bl.load(baseline_path or bl.DEFAULT_PATH)
+    if rule_names:
+        # subset run: other rules' grandfathered entries are out of scope,
+        # not stale
+        base = type(base)({k: v for k, v in base.items() if k[0] in ran})
+    new, stale = bl.diff(kept, base)
+    baselined = [f for f in kept if f not in new]
+    return Report(findings=new, baselined=baselined, suppressed=suppressed,
+                  stale_baseline=stale, rule_counts=rule_counts,
+                  rule_seconds=rule_seconds,
+                  files_scanned=len(index.files),
+                  wall_seconds=time.monotonic() - t0,
+                  rules_run=[r.name for r in rules])

@@ -1,0 +1,98 @@
+"""tpulint CLI: ``python -m tools.analysis``.
+
+Exit 0 only when the tree is clean: zero non-baselined findings, zero
+stale baseline entries, zero unused suppressions.  ``--update-baseline``
+rewrites the committed grandfather file from the live run;
+``--write-knob-docs`` regenerates docs/KNOBS.md from the knob registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from . import repo_root, run_analysis
+    from . import baseline as bl
+    from . import knobdocs
+
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="tpulint — AST/dataflow static analysis for the "
+                    "trino-tpu engine")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (findings + stats)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the committed baseline from this run")
+    p.add_argument("--write-knob-docs", action="store_true",
+                   help="regenerate docs/KNOBS.md from the knob registry "
+                        "and exit")
+    p.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--baseline", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--stats-out", default=None,
+                   help="also write run stats JSON to this path")
+    args = p.parse_args(argv)
+
+    root = args.root or repo_root()
+
+    if args.write_knob_docs:
+        out = knobdocs.write(root)
+        print(f"wrote {out}")
+        return 0
+
+    if args.list_rules:
+        from .rules import all_rules
+        for r in all_rules():
+            print(f"{r.name:20s} {r.doc}")
+        return 0
+
+    rule_names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                  if args.rules else None)
+    report = run_analysis(root, rule_names, args.baseline)
+
+    if args.update_baseline:
+        path = args.baseline or bl.DEFAULT_PATH
+        bl.write(report.findings + report.baselined, path)
+        print(f"baseline updated: {path} "
+              f"({len(report.findings) + len(report.baselined)} entries)")
+        return 0
+
+    if args.stats_out:
+        with open(args.stats_out, "w", encoding="utf-8") as f:
+            json.dump(report.stats(), f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in report.findings],
+            "stale_baseline": [
+                {"rule": r, "path": p_, "message": m, "count": c}
+                for r, p_, m, c in report.stale_baseline],
+            "stats": report.stats(),
+        }, indent=1, sort_keys=True))
+        return 0 if report.clean else 1
+
+    for f in report.findings:
+        print(f.format())
+    for rule, path, message, count in report.stale_baseline:
+        print(f"{path}: [baseline] stale entry ({rule}: {message!r} "
+              f"x{count}) — violation fixed, run --update-baseline")
+    s = report.stats()
+    status = "clean" if report.clean else (
+        f"{len(report.findings)} finding(s), "
+        f"{len(report.stale_baseline)} stale baseline entr(ies)")
+    print(f"tpulint: {status} — {s['files_scanned']} files, "
+          f"{len(report.rules_run)} rules, {s['wall_seconds']}s "
+          f"({len(report.baselined)} baselined, "
+          f"{len(report.suppressed)} suppressed)", file=sys.stderr)
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

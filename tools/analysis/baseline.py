@@ -1,0 +1,61 @@
+"""tpulint baseline: committed grandfathered findings.
+
+The baseline is an *exact* contract, not a ratchet that only counts: the
+committed file must match the current run key-for-key.  A finding not in
+the baseline fails the run (new violation); a baseline entry with no
+matching finding ALSO fails the run (stale entry — the violation was fixed
+but the grandfather clause lingers).  ``--update-baseline`` rewrites the
+file from the live run; review the diff like any other code change.
+
+Keys are (rule, path, message) with multiplicity — no line numbers, so an
+unrelated edit above a grandfathered finding does not churn the file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baseline.json")
+VERSION = 1
+
+
+def load(path: str = DEFAULT_PATH) -> Counter:
+    """-> Counter[(rule, path, message)] of grandfathered findings."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts: Counter = Counter()
+    for e in data.get("entries", []):
+        counts[(e["rule"], e["path"], e["message"])] += int(e.get("count", 1))
+    return counts
+
+
+def write(findings: list, path: str = DEFAULT_PATH) -> None:
+    counts: Counter = Counter(f.key() for f in findings)
+    entries = [{"rule": r, "path": p, "message": m, "count": c}
+               for (r, p, m), c in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": VERSION, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def diff(findings: list, baseline: Counter) -> tuple:
+    """-> (new_findings, stale_entries).  ``new_findings`` are Finding
+    objects beyond the baselined multiplicity; ``stale_entries`` are
+    (rule, path, message, count) tuples the baseline grants but the run no
+    longer produces."""
+    remaining = Counter(baseline)
+    new = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+        else:
+            new.append(f)
+    stale = [(r, p, m, c) for (r, p, m), c in sorted(remaining.items())
+             if c > 0]
+    return new, stale

@@ -1,0 +1,396 @@
+"""tpulint core: shared parse / symbol / callgraph infrastructure.
+
+The five grep lints under ``tools/`` match *text*; they miss aliased calls,
+multi-line forms, and whole invariant classes (thread-safety, knob
+registry) that only an AST view can express.  This module is the shared
+substrate every tpulint rule builds on:
+
+- :class:`ProjectIndex` — every repo python file parsed once (``ast``),
+  with a module-level symbol table (functions, classes, import aliases)
+  and a best-effort static callgraph over qualified names;
+- :class:`Finding` — one diagnostic, stable-keyed for baselining;
+- :class:`Suppression` — ``# tpulint: disable=RULE[,RULE] -- reason``
+  pragmas, same-line or own-line-above, plus ``disable-file=`` for module
+  scope; unused suppressions are themselves findings so stale pragmas
+  cannot accumulate.
+
+Rules live in ``tools/analysis/rules/`` and receive the index; they return
+findings and never print.  Output, baselining, and exit codes are owned by
+``tools/analysis/__main__.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+# directories/files indexed by default (repo-relative).  tests/ rides along
+# for the hygiene rule; tools/ itself is NOT indexed — the lint does not
+# lint itself (its fixtures would trip every rule).
+DEFAULT_INCLUDE = ("trino_tpu", "tests", "bench.py", "__graft_entry__.py")
+
+DIRECTIVE = re.compile(
+    r"#\s*tpulint:\s*(?P<verb>disable-file|disable)\s*=\s*"
+    r"(?P<rules>[a-z0-9_\-]+(?:\s*,\s*[a-z0-9_\-]+)*)"
+    r"(?:\s*--\s*(?P<reason>.*))?")
+
+
+@dataclass
+class Finding:
+    """One diagnostic.  ``key()`` deliberately excludes the line number so a
+    committed baseline survives unrelated edits above the finding; the
+    baseline stores (rule, path, message) with multiplicity instead."""
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+    snippet: str = ""
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.message)
+
+    def format(self) -> str:
+        tail = f"  | {self.snippet}" if self.snippet else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tail}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "snippet": self.snippet}
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# tpulint: disable=...`` pragma.  ``target_line`` is the
+    line findings must sit on for it to apply (None = whole file).  A rule
+    listed here that suppressed nothing is an unused-suppression finding —
+    pragmas must not outlive the violation they excuse."""
+
+    path: str
+    directive_line: int
+    target_line: Optional[int]      # None => file scope
+    rules: tuple
+    reason: str
+    used: set = field(default_factory=set)
+
+    def applies(self, finding: Finding) -> bool:
+        if finding.path != self.path or finding.rule not in self.rules:
+            return False
+        return self.target_line is None or finding.line == self.target_line
+
+
+@dataclass
+class SourceFile:
+    rel: str
+    path: str
+    text: str
+    lines: list
+    tree: Optional[ast.Module]
+    parse_error: Optional[str]
+    suppressions: list
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def _parse_suppressions(rel: str, lines: list) -> list:
+    sups = []
+    for i, raw in enumerate(lines, 1):
+        m = DIRECTIVE.search(raw)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(","))
+        reason = (m.group("reason") or "").strip()
+        if m.group("verb") == "disable-file":
+            target = None
+        elif raw[:m.start()].strip():
+            target = i                      # trailing pragma: same line
+        else:
+            target = i + 1                  # own-line pragma: line below
+        sups.append(Suppression(rel, i, target, rules, reason))
+    return sups
+
+
+@dataclass
+class FuncInfo:
+    qualname: str                   # "<rel>::<name>" or "<rel>::<Cls>.<name>"
+    rel: str
+    name: str
+    cls: Optional[str]
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef
+
+
+@dataclass
+class ClassInfo:
+    qualname: str                   # "<rel>::<Cls>"
+    rel: str
+    name: str
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)     # name -> FuncInfo
+    bases: list = field(default_factory=list)       # base-class name strings
+
+
+class ModuleInfo:
+    """Per-module import aliases + top-level symbol map, the raw material
+    for callgraph edge resolution."""
+
+    def __init__(self, rel: str, tree: Optional[ast.Module]):
+        self.rel = rel
+        # alias -> dotted module path ("import trino_tpu.exec.kernels as K")
+        self.module_aliases: dict = {}
+        # name -> (dotted module path, original name)   ("from x import y")
+        self.from_imports: dict = {}
+        if tree is not None:
+            self._collect(tree)
+
+    def _dots_to_package(self, level: int) -> str:
+        """Resolve a relative-import level against this module's location."""
+        parts = self.rel[:-3].split("/")        # strip .py
+        # level=1 → same package: drop the module filename
+        keep = len(parts) - level
+        return ".".join(parts[:keep]) if keep > 0 else ""
+
+    def _collect(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.module_aliases[a.asname] = a.name
+                    else:
+                        # "import a.b.c" binds only the top name "a"
+                        top = a.name.split(".")[0]
+                        self.module_aliases[top] = top
+                        # but "a.b.c.f()" is resolvable through full paths:
+                        # keep the dotted form reachable under itself
+                        self.module_aliases.setdefault(a.name, a.name)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = self._dots_to_package(node.level)
+                    base = f"{pkg}.{base}".strip(".") if base else pkg
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.from_imports[a.asname or a.name] = (base, a.name)
+
+
+def _module_rel(dotted: str, files: dict) -> Optional[str]:
+    """Dotted module path -> repo-relative file, if indexed."""
+    cand = dotted.replace(".", "/") + ".py"
+    if cand in files:
+        return cand
+    init = dotted.replace(".", "/") + "/__init__.py"
+    if init in files:
+        return init
+    return None
+
+
+class ProjectIndex:
+    """Every indexed file parsed once, plus symbols and a callgraph."""
+
+    def __init__(self, root: str, files: dict):
+        self.root = root
+        self.files = files                      # rel -> SourceFile
+        self.functions: dict = {}               # qualname -> FuncInfo
+        self.classes: dict = {}                 # qualname -> ClassInfo
+        self.modules: dict = {}                 # rel -> ModuleInfo
+        self._callgraph: Optional[dict] = None
+        self._build_symbols()
+
+    # ---------------------------------------------------------------- build
+
+    @classmethod
+    def build(cls, root: str, include=DEFAULT_INCLUDE) -> "ProjectIndex":
+        files: dict = {}
+        for entry in include:
+            abs_entry = os.path.join(root, entry)
+            if os.path.isfile(abs_entry):
+                cls._load(files, root, entry)
+                continue
+            for dirpath, dirnames, filenames in os.walk(abs_entry):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn),
+                                              root).replace(os.sep, "/")
+                        cls._load(files, root, rel)
+        return cls(root, files)
+
+    @staticmethod
+    def _load(files: dict, root: str, rel: str) -> None:
+        path = os.path.join(root, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            files[rel] = SourceFile(rel, path, "", [], None, str(e), [])
+            return
+        lines = text.splitlines()
+        tree, err = None, None
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            err = f"syntax error: {e.msg} (line {e.lineno})"
+        files[rel] = SourceFile(rel, path, text, lines, tree, err,
+                                _parse_suppressions(rel, lines))
+
+    def _build_symbols(self) -> None:
+        for rel, sf in self.files.items():
+            self.modules[rel] = ModuleInfo(rel, sf.tree)
+            if sf.tree is None:
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(f"{rel}::{node.name}", rel, node.name,
+                                  None, node)
+                    self.functions[fi.qualname] = fi
+                elif isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(f"{rel}::{node.name}", rel, node.name,
+                                   node)
+                    ci.bases = [ast.unparse(b) for b in node.bases]
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            fi = FuncInfo(f"{rel}::{node.name}.{sub.name}",
+                                          rel, sub.name, node.name, sub)
+                            ci.methods[sub.name] = fi
+                            self.functions[fi.qualname] = fi
+                    self.classes[ci.qualname] = ci
+
+    # ------------------------------------------------------------ iteration
+
+    def iter_files(self, prefixes=None) -> Iterator[SourceFile]:
+        for rel in sorted(self.files):
+            if prefixes is None or any(rel.startswith(p) or rel == p
+                                       for p in prefixes):
+                yield self.files[rel]
+
+    def suppressions(self) -> Iterator[Suppression]:
+        for sf in self.files.values():
+            yield from sf.suppressions
+
+    # ------------------------------------------------------------ callgraph
+
+    def resolve_call(self, rel: str, caller: FuncInfo,
+                     call: ast.Call) -> Optional[str]:
+        """Best-effort static resolution of a call site to a qualname in
+        this index.  Handles: plain names (module-local or ``from``-import),
+        ``self.method`` within a class, and ``mod.func`` through an import
+        alias.  Unresolvable dynamic dispatch returns None — the callgraph
+        is deliberately an under-approximation; rules that need reachability
+        accept that trade against false-positive floods."""
+        mod = self.modules[rel]
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            local = f"{rel}::{fn.id}"
+            if local in self.functions:
+                return local
+            if fn.id in mod.from_imports:
+                dotted, orig = mod.from_imports[fn.id]
+                target_rel = _module_rel(dotted, self.files)
+                if target_rel:
+                    q = f"{target_rel}::{orig}"
+                    if q in self.functions:
+                        return q
+                # "from .mod import Cls" then Cls(...) — constructor edge
+                if target_rel:
+                    cq = f"{target_rel}::{orig}"
+                    if cq in self.classes:
+                        init = self.classes[cq].methods.get("__init__")
+                        return init.qualname if init else None
+            return None
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self" and caller.cls:
+                cls = self.classes.get(f"{rel}::{caller.cls}")
+                if cls and fn.attr in cls.methods:
+                    return cls.methods[fn.attr].qualname
+                return None
+            if isinstance(base, ast.Name):
+                dotted = None
+                if base.id in mod.module_aliases:
+                    dotted = mod.module_aliases[base.id]
+                elif base.id in mod.from_imports:
+                    # "from trino_tpu.exec import kernels" → module object
+                    pkg, orig = mod.from_imports[base.id]
+                    dotted = f"{pkg}.{orig}".strip(".")
+                if dotted:
+                    target_rel = _module_rel(dotted, self.files)
+                    if target_rel:
+                        q = f"{target_rel}::{fn.attr}"
+                        if q in self.functions:
+                            return q
+        return None
+
+    def callgraph(self) -> dict:
+        """qualname -> set of callee qualnames (cached)."""
+        if self._callgraph is not None:
+            return self._callgraph
+        graph: dict = {}
+        for q, fi in self.functions.items():
+            out = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve_call(fi.rel, fi, node)
+                    if callee:
+                        out.add(callee)
+            graph[q] = out
+        self._callgraph = graph
+        return graph
+
+    def reachable(self, roots) -> set:
+        """Transitive closure over the callgraph from ``roots`` qualnames."""
+        graph = self.callgraph()
+        seen = set()
+        stack = [r for r in roots if r in graph]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(graph.get(q, ()) - seen)
+        return seen
+
+    def enclosing_function(self, rel: str, node: ast.AST) -> Optional[FuncInfo]:
+        """The FuncInfo whose source span contains ``node`` (innermost)."""
+        best = None
+        for q, fi in self.functions.items():
+            if fi.rel != rel:
+                continue
+            end = getattr(fi.node, "end_lineno", fi.node.lineno)
+            if fi.node.lineno <= node.lineno <= end:
+                if best is None or fi.node.lineno > best.node.lineno:
+                    best = fi
+        return best
+
+
+def apply_suppressions(index: ProjectIndex, findings: list,
+                       ran_rules: set) -> tuple:
+    """Split findings into (kept, suppressed); mark pragmas used; append
+    unused-suppression findings for pragmas naming a rule that ran but
+    excused nothing."""
+    sups = list(index.suppressions())
+    kept, suppressed = [], []
+    for f in findings:
+        hit = None
+        for s in sups:
+            if s.applies(f):
+                s.used.add(f.rule)
+                hit = s
+                break
+        (suppressed if hit else kept).append(f)
+    for s in sups:
+        for rule in s.rules:
+            if rule in ran_rules and rule != "unused-suppression" \
+                    and rule not in s.used:
+                kept.append(Finding(
+                    "unused-suppression", s.path, s.directive_line,
+                    f"suppression for '{rule}' matches no finding — remove "
+                    f"the stale pragma"))
+    return kept, suppressed

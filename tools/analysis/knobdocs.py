@@ -1,0 +1,81 @@
+"""docs/KNOBS.md generation from the knob registry, and its drift check.
+
+The registry (``trino_tpu/spi/knobs.py``) keeps its declarations as pure
+literals so this module can read them with ``ast`` — no jax import, no
+side effects — and render the operator-facing table deterministically.
+``python -m tools.analysis --write-knob-docs`` writes the file; the
+``knob-docs`` tpulint rule fails when the committed file differs
+byte-for-byte from a fresh render, so a knob added (or retyped, or
+re-documented) without regenerating the docs fails the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+KNOBS_REL = "trino_tpu/spi/knobs.py"
+DOCS_REL = "docs/KNOBS.md"
+
+HEADER = """\
+# TRINO_TPU_* environment knobs
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source of truth: trino_tpu/spi/knobs.py
+     Regenerate with:  python -m tools.analysis --write-knob-docs
+     Drift fails the knob-docs tpulint rule. -->
+
+Every environment knob the engine reads, generated from the central
+registry in `trino_tpu/spi/knobs.py`.  An empty default means *unset*
+(the code-side fallback documented in the description applies).  Boolean
+knobs accept `1/true/yes/on` and `0/false/no/off`.
+
+| Knob | Type | Default | Description |
+|------|------|---------|-------------|
+"""
+
+
+def extract(root: str) -> list:
+    """-> [(name, type, default, doc, choices)] from the registry AST."""
+    path = os.path.join(root, KNOBS_REL)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    entries = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "Knob"):
+            continue
+        args = [a.value for a in node.args if isinstance(a, ast.Constant)]
+        if len(args) < 4 or not isinstance(args[0], str):
+            raise ValueError(
+                f"{KNOBS_REL}:{node.lineno}: Knob declaration is not pure "
+                f"literals — the registry must stay statically readable")
+        choices = None
+        for kw in node.keywords:
+            if kw.arg == "choices" and isinstance(kw.value, ast.Tuple):
+                choices = tuple(e.value for e in kw.value.elts
+                                if isinstance(e, ast.Constant))
+        entries.append((args[0], args[1], args[2], args[3], choices))
+    entries.sort()
+    return entries
+
+
+def render(entries: list) -> str:
+    rows = []
+    for name, type_, default, doc, choices in entries:
+        shown_type = type_
+        if choices:
+            shown_type = f"enum({', '.join(choices)})"
+        shown_default = f"`{default}`" if default else "*(unset)*"
+        rows.append(f"| `{name}` | {shown_type} | {shown_default} "
+                    f"| {doc} |")
+    return HEADER + "\n".join(rows) + f"\n\n{len(entries)} knobs.\n"
+
+
+def write(root: str) -> str:
+    out = os.path.join(root, DOCS_REL)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    text = render(extract(root))
+    with open(out, "w", encoding="utf-8") as f:
+        f.write(text)
+    return out

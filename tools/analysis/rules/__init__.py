@@ -1,0 +1,56 @@
+"""tpulint rule registry.
+
+Each rule module exports ``RULES`` — a list of :class:`Rule` whose
+``check(index)`` returns findings.  Order here is presentation order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    check: Callable
+
+    def __call__(self, index):
+        return self.check(index)
+
+
+def all_rules() -> list:
+    # imported lazily so a syntax error in one rule module names itself
+    # instead of breaking the registry import
+    from . import (cache_bounds, error_taxonomy, host_sync, hygiene,
+                   journal_schema, knob_registry, metric_names, net_timeout,
+                   thread_safety)
+
+    rules: list = []
+    for mod in (host_sync, thread_safety, knob_registry, error_taxonomy,
+                net_timeout, metric_names, cache_bounds, journal_schema,
+                hygiene):
+        rules.extend(mod.RULES)
+    return rules
+
+
+def rules_by_name() -> dict:
+    return {r.name: r for r in all_rules()}
+
+
+def rule_main(*names, epilogue: str = "") -> int:
+    """Shared CLI body for the legacy ``tools/lint_*.py`` shims: run the
+    named rule(s) through the full pipeline (suppressions + baseline), so
+    a shim invocation agrees exactly with ``python -m tools.analysis``."""
+    import sys
+
+    from .. import run_analysis
+
+    report = run_analysis(rule_names=list(names))
+    for f in report.findings:
+        print(f.format(), file=sys.stderr)
+    if report.findings and epilogue:
+        print(f"{len(report.findings)} finding(s) — {epilogue}",
+              file=sys.stderr)
+    return 0 if report.clean else 1

@@ -1,0 +1,125 @@
+"""cache-bounds: no new unbounded memoization outside the registry.
+
+AST successor of the grep lint tools/lint_cache_bounds.py.  PR 12
+centralized every jitted-program memo behind
+``trino_tpu/caching/executable_cache.jit_memo`` — bounded, observable via
+``system.runtime.caches``, evictable, and journaled for boot-time warming.
+An ad-hoc ``@lru_cache(maxsize=None)`` on a jit-wrapper builder silently
+reintroduces the pre-PR-12 failure mode.  Rejected forms:
+
+- bare ``@lru_cache`` / ``@functools.lru_cache`` (unbounded)
+- ``lru_cache()`` / ``lru_cache(maxsize=None)`` anywhere (not just as a
+  decorator — the AST sees ``f = lru_cache(maxsize=None)(f)`` too)
+- ``@functools.cache`` / ``@cache`` (always unbounded)
+
+Bounded ``lru_cache(maxsize=N)`` passes.  The registry module itself
+(caching/executable_cache.py) is exempt: the ``TRINO_TPU_EXEC_CACHE=0``
+kill switch intentionally falls back to the bit-for-bit legacy unbounded
+memo there.  A justified exception elsewhere carries the legacy
+``# cache-ok`` pragma or a ``# tpulint: disable=cache-bounds`` directive.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ProjectIndex
+from . import Rule
+
+NAME = "cache-bounds"
+SCAN_DIR = "trino_tpu"
+EXEMPT = "trino_tpu/caching/executable_cache.py"
+LEGACY_PRAGMA = "cache-ok"
+MESSAGE = ("unbounded memo cache — use caching.executable_cache.jit_memo "
+           "(bounded, observable, warm-journaled) or lru_cache(maxsize=N)")
+
+
+def _is_cache_name(node: ast.AST, names: tuple) -> bool:
+    return ((isinstance(node, ast.Name) and node.id in names)
+            or (isinstance(node, ast.Attribute) and node.attr in names
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "functools"))
+
+
+def _unbounded_nodes(tree: ast.Module) -> list:
+    """-> [lineno] of every unbounded-memo form in one parsed module."""
+    out = []
+    decorator_calls = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            for dec in node.decorator_list:
+                if _is_cache_name(dec, ("lru_cache", "cache")):
+                    # bare @lru_cache / @cache — always unbounded
+                    out.append(dec.lineno)
+                elif isinstance(dec, ast.Call):
+                    decorator_calls.add(id(dec))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _is_cache_name(node.func, ("lru_cache",))):
+            continue
+        maxsize = None
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                maxsize = kw.value
+        if node.args:
+            maxsize = node.args[0]
+        unbounded = (maxsize is None
+                     or (isinstance(maxsize, ast.Constant)
+                         and maxsize.value is None))
+        if unbounded:
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
+def _file_findings(tree: ast.Module, lines: list) -> list:
+    return [lineno for lineno in _unbounded_nodes(tree)
+            if LEGACY_PRAGMA not in (lines[lineno - 1]
+                                     if lineno <= len(lines) else "")]
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    for sf in index.iter_files((SCAN_DIR + "/",)):
+        if sf.tree is None or sf.rel == EXEMPT:
+            continue
+        for lineno in _file_findings(sf.tree, sf.lines):
+            findings.append(Finding(NAME, sf.rel, lineno, MESSAGE,
+                                    sf.line(lineno).strip()))
+    return findings
+
+
+# ----------------------------------------------------- legacy shim surface
+
+def lint_file(path: str) -> list:
+    """Compat: -> [(path, lineno, problem)] for one file."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tree = ast.parse(text, filename=path)
+    return [(path, lineno, MESSAGE)
+            for lineno in _file_findings(tree, text.splitlines())]
+
+
+def run(root: str) -> list:
+    import os
+
+    findings = []
+    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path.replace(os.sep, "/").endswith(EXEMPT):
+                continue
+            findings.extend(lint_file(path))
+    return findings
+
+
+def main() -> int:
+    from . import rule_main
+    return rule_main(NAME, epilogue="bound the memo or route it through "
+                     "caching.executable_cache.jit_memo")
+
+
+RULES = [Rule(NAME, "no unbounded lru_cache/cache memos outside the "
+              "executable registry", check)]

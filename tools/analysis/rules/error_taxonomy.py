@@ -1,0 +1,113 @@
+"""error-taxonomy: failures on the query path are classified, not generic.
+
+The resilience layer (spi/errors.py) only works when every failure the
+coordinator acts on carries an ErrorCode: USER errors must never retry,
+EXTERNAL ones must blacklist the implicated worker, INSUFFICIENT_RESOURCES
+must grow the budget.  A ``raise RuntimeError`` on the query path — or a
+handler that swallows ``Exception`` whole — punches a hole in that
+contract: the failure degrades to GENERIC_INTERNAL_ERROR (retrying user
+bugs) or vanishes entirely.  Three checks over ``trino_tpu/execution/``
+and ``trino_tpu/exec/``:
+
+- **bare except** — ``except:`` catches SystemExit/KeyboardInterrupt and
+  is never right; flagged everywhere in scope.
+- **blind swallow** — ``except Exception: pass`` (body only pass/constant)
+  silently discards a failure the taxonomy should have classified.
+  Narrow swallows (``except FileNotFoundError: pass``) are fine.
+- **generic raise** — ``raise RuntimeError/ValueError/... (...)`` on the
+  query path must be a :class:`TrinoError` with a real code, or routed
+  through ``spi.errors.classify``.  ``NotImplementedError`` (feature
+  gaps classified NOT_SUPPORTED at the boundary) and ``AssertionError``
+  (invariants) stay allowed.
+
+Deliberate exceptions carry ``# tpulint: disable=error-taxonomy --
+reason``; grandfathered pre-registry sites live in the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ProjectIndex
+from . import Rule
+
+NAME = "error-taxonomy"
+SCAN = ("trino_tpu/execution/", "trino_tpu/exec/")
+
+# generic builtins that erase classification when raised on the query path
+GENERIC_RAISES = {
+    "Exception", "BaseException", "RuntimeError", "ValueError", "TypeError",
+    "KeyError", "IndexError", "OSError", "IOError", "SystemError",
+    "StopIteration", "ArithmeticError", "ZeroDivisionError",
+}
+BROAD_CATCHES = {"Exception", "BaseException"}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set:
+    t = handler.type
+    if t is None:
+        return set()
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.add(e.attr)
+    return names
+
+
+def _body_swallows(body: list) -> bool:
+    """True when the handler body does nothing with the failure: only
+    pass/Ellipsis/docstring statements."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue
+        return False
+    return True
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    for sf in index.iter_files(SCAN):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ExceptHandler):
+                if node.type is None:
+                    findings.append(Finding(
+                        NAME, sf.rel, node.lineno,
+                        "bare 'except:' catches SystemExit/"
+                        "KeyboardInterrupt — name the exception and "
+                        "classify it (spi.errors.classify)",
+                        sf.line(node.lineno).strip()))
+                elif (_handler_names(node) & BROAD_CATCHES
+                      and _body_swallows(node.body)):
+                    findings.append(Finding(
+                        NAME, sf.rel, node.lineno,
+                        "blind 'except Exception: pass' swallows a "
+                        "failure the error taxonomy should classify — "
+                        "narrow the type, log it, or re-raise classified",
+                        sf.line(node.lineno).strip()))
+            elif isinstance(node, ast.Raise):
+                exc = node.exc
+                if not isinstance(exc, ast.Call):
+                    continue
+                fn = exc.func
+                name = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if name in GENERIC_RAISES:
+                    findings.append(Finding(
+                        NAME, sf.rel, node.lineno,
+                        f"raise {name} on the query path erases error "
+                        f"classification — raise TrinoError with a real "
+                        f"ErrorCode or route through spi.errors.classify",
+                        sf.line(node.lineno).strip()))
+    return findings
+
+
+RULES = [Rule(NAME, "no bare/blind excepts or generic unclassified raises "
+              "on the query path", check)]

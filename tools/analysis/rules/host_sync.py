@@ -1,0 +1,307 @@
+"""host-sync: no implicit device->host syncs on the exec hot path.
+
+Two layers, replacing and subsuming the grep lint tools/lint_host_sync.py:
+
+**Pattern layer** (the old grep, kept verbatim): raw sync spellings
+(``int(np.asarray(...))``, ``.item()``, ``jax.device_get``,
+``block_until_ready``) anywhere in the sync-free-contract directories.
+Text-level, catches even code the AST layer cannot type.
+
+**Dataflow layer** (new): the grep misses *aliased* and *implicit* syncs —
+``bool(mask)`` where ``mask`` is a jax array, ``if total:`` truthiness on a
+device scalar, ``np.asarray(dev)`` — because nothing in the spelling says
+"device".  This layer infers which locals hold device values (assigned
+from ``jnp.*`` / ``jax.*`` calls, arithmetic over device operands, device
+method chains, params annotated as arrays), then flags implicit-sync
+constructs on them: ``bool()/int()/float()/len()``, ``.item()`` /
+``.tolist()``, ``np.asarray()``, and truthiness branches.  It runs only in
+functions *reachable from SyncGuard hot regions* via the project callgraph
+(``with SG.hot_region():`` call sites are the roots), so a cold config
+path can truthiness-test a device flag without noise while the same code
+reachable from the steady-state loop is flagged.
+
+A justified exception carries the legacy ``# sync-ok`` pragma or a
+``# tpulint: disable=host-sync`` directive.  exec/syncguard.py is exempt —
+it IS the sanctioned wrapper.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding, FuncInfo, ProjectIndex
+from . import Rule
+
+NAME = "host-sync"
+
+# ---------------------------------------------------------- pattern layer
+# each pattern is (regex, human label); kept deliberately dumb — greppable
+# — so the legacy shim behaves bit-for-bit like the old grep lint
+PATTERNS: list = [
+    (re.compile(r"\bint\(np\.asarray\("), "int(np.asarray(...)) blocking sync"),
+    (re.compile(r"\bbool\(np\.asarray\("),
+     "bool(np.asarray(...)) blocking sync"),
+    (re.compile(r"\bfloat\(np\.asarray\("),
+     "float(np.asarray(...)) blocking sync"),
+    (re.compile(r"\.item\(\)"), ".item() blocking sync"),
+    (re.compile(r"\bjax\.device_get\("), "raw jax.device_get (use SG.fetch)"),
+    (re.compile(r"block_until_ready\("),
+     "block_until_ready blocking sync (use SG.fetch / SG.async_scalar)"),
+]
+
+# parallel/ rides along: static_agg and the shard_map pipelines promise
+# sync-free bodies, so raw fetches there are as load-bearing a bug as in exec
+SCAN_DIRS = ("trino_tpu/exec", "trino_tpu/ops", "trino_tpu/parallel")
+# the fused-stage path promises ZERO host syncs between input deposit and
+# output take, and the collective exchange is its legacy twin
+SCAN_FILES = ("trino_tpu/execution/stage_compiler.py",
+              "trino_tpu/execution/collective_exchange.py")
+EXEMPT_FILES = ("syncguard.py",)  # the sanctioned wrapper itself
+PRAGMA = "sync-ok"
+
+
+def lint_file(path: str) -> list:
+    """Pattern layer over one file (compat with the old grep lint):
+    -> [(path, lineno, label, source_line)]."""
+    findings = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            if PRAGMA in line:
+                continue
+            for pat, label in PATTERNS:
+                if pat.search(line):
+                    findings.append((path, lineno, label, line.strip()))
+    return findings
+
+
+def run(root: str) -> list:
+    """Pattern layer over the sync-free-contract tree (compat)."""
+    findings = []
+    paths = []
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py") and fn not in EXEMPT_FILES:
+                    paths.append(os.path.join(dirpath, fn))
+    for f in SCAN_FILES:
+        paths.append(os.path.join(root, f))
+    for path in paths:
+        if os.path.exists(path):
+            findings.extend(lint_file(path))
+    return findings
+
+
+# --------------------------------------------------------- dataflow layer
+
+# sync-forcing builtins: truthiness/scalarization of a device value blocks
+# on the device round trip
+SYNC_BUILTINS = {"bool", "int", "float", "len"}
+# device methods whose CALL is itself a host materialization
+SYNC_METHODS = {"item", "tolist", "to_py"}
+
+
+def _jax_aliases(index: ProjectIndex, rel: str) -> set:
+    """Local names that denote the jax / jax.numpy modules."""
+    mod = index.modules[rel]
+    out = set()
+    for alias, dotted in mod.module_aliases.items():
+        if dotted in ("jax", "jax.numpy"):
+            out.add(alias)
+    for alias, (pkg, orig) in mod.from_imports.items():
+        if (pkg, orig) == ("jax", "numpy"):
+            out.add(alias)
+    return out
+
+
+def _np_aliases(index: ProjectIndex, rel: str) -> set:
+    mod = index.modules[rel]
+    return {a for a, dotted in mod.module_aliases.items()
+            if dotted == "numpy"}
+
+
+_ARRAY_ANNOTATIONS = ("jnp.ndarray", "jax.Array", "Array", "ArrayLike")
+
+
+class _DeviceInference:
+    """Flow-insensitive per-function inference of which local names hold
+    device (jax array) values.  Deliberately an under-approximation: only
+    values provably rooted in a jax call/annotation are device, so every
+    flag the dataflow layer raises is rooted in evidence."""
+
+    def __init__(self, fn: ast.AST, jax_names: set):
+        self.jax = jax_names
+        self.device: set = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if a.annotation is not None:
+                ann = ast.unparse(a.annotation)
+                if any(t in ann for t in _ARRAY_ANNOTATIONS):
+                    self.device.add(a.arg)
+        # two passes so a name assigned late still taints earlier reads
+        # (loops re-bind; flow-insensitivity is the safe direction here)
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if self.is_device(node.value):
+                        for t in node.targets:
+                            self._bind(t)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    if node.value is not None and self.is_device(node.value):
+                        self._bind(node.target)
+
+    def _bind(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.device.add(target.id)
+
+    def is_device(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.device
+        if isinstance(e, ast.BinOp):
+            return self.is_device(e.left) or self.is_device(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.is_device(e.operand)
+        if isinstance(e, ast.Compare):
+            return (self.is_device(e.left)
+                    or any(self.is_device(c) for c in e.comparators))
+        if isinstance(e, ast.Subscript):
+            return self.is_device(e.value)
+        if isinstance(e, ast.Call):
+            fn = e.func
+            if isinstance(fn, ast.Attribute):
+                base = fn.value
+                # jnp.sum(...) / jax.lax.select(...) — rooted in jax
+                root = base
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in self.jax:
+                    return True
+                # method chain on a device value stays device, except the
+                # sync methods which land on the host (and are flagged)
+                if fn.attr not in SYNC_METHODS and self.is_device(base):
+                    return True
+            return False
+        if isinstance(e, ast.IfExp):
+            return self.is_device(e.body) or self.is_device(e.orelse)
+        return False
+
+
+def _hot_region_roots(index: ProjectIndex) -> tuple:
+    """-> (regions, roots): each region is (rel, FuncInfo, with_node) for a
+    ``with SG.hot_region():`` block; roots are the callgraph qualnames of
+    calls made inside those blocks."""
+    regions = []
+    roots = set()
+    for sf in index.iter_files(("trino_tpu/",)):
+        if sf.tree is None or "hot_region" not in sf.text:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any("hot_region" in ast.unparse(item.context_expr)
+                       for item in node.items):
+                continue
+            owner = index.enclosing_function(sf.rel, node)
+            if owner is None:
+                continue
+            regions.append((sf.rel, owner, node))
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    callee = index.resolve_call(sf.rel, owner, sub)
+                    if callee:
+                        roots.add(callee)
+    return regions, roots
+
+
+def _flag_nodes(fi: FuncInfo, inf: _DeviceInference, np_names: set,
+                within: ast.AST) -> list:
+    """-> [(lineno, message)] implicit-sync constructs under ``within``."""
+    out = []
+
+    def flag(node, msg):
+        out.append((node.lineno, msg))
+
+    for node in ast.walk(within):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id in SYNC_BUILTINS
+                    and len(node.args) == 1
+                    and inf.is_device(node.args[0])):
+                flag(node, f"{fn.id}() on a device value forces a host "
+                     "sync — route through SG.fetch / SG.async_scalar")
+            elif (isinstance(fn, ast.Attribute)
+                  and fn.attr in SYNC_METHODS
+                  and inf.is_device(fn.value)):
+                flag(node, f".{fn.attr}() on a device value forces a host "
+                     "sync — route through SG.fetch / SG.async_scalar")
+            elif (isinstance(fn, ast.Attribute) and fn.attr == "asarray"
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id in np_names
+                  and node.args and inf.is_device(node.args[0])):
+                flag(node, "np.asarray() on a device value forces a host "
+                     "sync — route through SG.fetch")
+        elif isinstance(node, (ast.If, ast.While)):
+            if inf.is_device(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                flag(node, f"truthiness of a device value in '{kind}' "
+                     "forces a host sync — fetch via SG first or keep the "
+                     "branch on-device (jnp.where / lax.cond)")
+        elif isinstance(node, ast.Assert):
+            if inf.is_device(node.test):
+                flag(node, "assert on a device value forces a host sync — "
+                     "fetch via SG first or use checkify-style lanes")
+    return out
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    seen = set()                        # (rel, lineno) dedupe across layers
+
+    # pattern layer — same scope as the old grep lint
+    prefixes = tuple(d + "/" for d in SCAN_DIRS) + SCAN_FILES
+    for sf in index.iter_files(prefixes):
+        if os.path.basename(sf.rel) in EXEMPT_FILES:
+            continue
+        for _path, lineno, label, line in lint_file(sf.path):
+            findings.append(Finding(NAME, sf.rel, lineno, label, line))
+            seen.add((sf.rel, lineno))
+
+    # dataflow layer — hot-region bodies + everything reachable from them
+    regions, roots = _hot_region_roots(index)
+    reachable = index.reachable(roots)
+    targets: list = []          # (FuncInfo, node-to-scan)
+    for rel, owner, with_node in regions:
+        targets.append((owner, with_node))
+    for q in sorted(reachable):
+        fi = index.functions[q]
+        targets.append((fi, fi.node))
+    for fi, scope in targets:
+        sf = index.files[fi.rel]
+        if os.path.basename(fi.rel) in EXEMPT_FILES or sf.tree is None:
+            continue
+        inf = _DeviceInference(fi.node, _jax_aliases(index, fi.rel))
+        if not inf.device:
+            continue
+        for lineno, msg in _flag_nodes(fi, inf, _np_aliases(index, fi.rel),
+                                       scope):
+            if (fi.rel, lineno) in seen:
+                continue
+            line = sf.line(lineno)
+            if PRAGMA in line:
+                continue
+            seen.add((fi.rel, lineno))
+            findings.append(Finding(NAME, fi.rel, lineno, msg, line.strip()))
+    return findings
+
+
+def main() -> int:
+    from . import rule_main
+    return rule_main(NAME, epilogue="route the transfer through "
+                     "exec/syncguard.py (SG.fetch / SG.async_scalar) or "
+                     "justify with a '# sync-ok' pragma")
+
+
+RULES = [Rule(NAME, "no raw or implicit device->host syncs on the exec "
+              "hot path", check)]

@@ -1,0 +1,64 @@
+"""test-hygiene: debug-leftover test files cannot reappear.
+
+PR 14 removed tests/test_dbg_tmp.py — a printing, assert-free scratch file
+that rode along in tier-1 for five PR generations.  This rule keeps the
+class out: any test module named like a debug leftover (``test_dbg_*``,
+``*_tmp``, ``*_scratch``) fails the lint, as does a test module containing
+no assertions at all (a test that can't fail is debris).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+
+from ..core import Finding, ProjectIndex
+from . import Rule
+
+NAME = "test-hygiene"
+SCAN = ("tests/",)
+DEBUG_NAME_PATTERNS = ("test_dbg_*.py", "test_debug_*.py", "*_tmp.py",
+                       "*_scratch.py")
+
+
+def _has_assertions(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assert):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # pytest.raises / pytest.warns / unittest assert* count
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name.startswith("assert") or name in ("raises", "warns",
+                                                     "approx"):
+                return True
+    return False
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    for sf in index.iter_files(SCAN):
+        base = os.path.basename(sf.rel)
+        if not base.startswith("test_"):
+            continue
+        for pat in DEBUG_NAME_PATTERNS:
+            if fnmatch.fnmatch(base, pat):
+                findings.append(Finding(
+                    NAME, sf.rel, 1,
+                    f"debug-leftover test file (name matches {pat!r}) — "
+                    f"fold real assertions into the owning suite and "
+                    f"delete this"))
+                break
+        else:
+            if sf.tree is not None and not _has_assertions(sf.tree):
+                findings.append(Finding(
+                    NAME, sf.rel, 1,
+                    "test module contains no assertions — a test that "
+                    "cannot fail is debug debris"))
+    return findings
+
+
+RULES = [Rule(NAME, "no debug-leftover or assertion-free test modules",
+              check)]

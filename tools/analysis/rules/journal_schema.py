@@ -1,0 +1,97 @@
+"""journal-schema: every record the query journal can emit is sound.
+
+Framework home of tools/lint_journal_schema.py.  The durable query journal
+(trino_tpu/telemetry/journal.py) is read back by
+``system.runtime.query_history`` and by the admission estimator's restart
+seeding, so a record that doesn't round-trip through JSON — or drops the
+versioned ``schema`` field — corrupts consumers long after the write went
+green.  This rule materializes one representative record per event type
+(``journal.sample_records()``) and enforces the contract up front.
+
+Unlike the pure-AST rules this one is *dynamic*: it imports the journal
+module and exercises its sample-record factory.  That is the point — the
+schema contract lives in code, and the only faithful check runs it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from ..core import Finding, ProjectIndex
+from . import Rule
+
+NAME = "journal-schema"
+JOURNAL_REL = "trino_tpu/telemetry/journal.py"
+
+_SCALARS = (str, int, float, bool, type(None))
+
+
+def lint_record(rec: dict) -> list:
+    """-> [problem] for one journal record (compat with the old tool)."""
+    problems = []
+    from trino_tpu.telemetry import journal
+
+    event = rec.get("event", "<unknown>")
+    try:
+        line = json.dumps(rec, allow_nan=False)
+    except (TypeError, ValueError) as e:
+        return [f"{event}: record does not JSON-serialize: {e}"]
+    back = json.loads(line)
+    if back != rec:
+        problems.append(f"{event}: record does not round-trip through JSON")
+    if rec.get("schema") != journal.SCHEMA_VERSION:
+        problems.append(
+            f"{event}: schema field is {rec.get('schema')!r}, expected "
+            f"{journal.SCHEMA_VERSION}")
+    for field in journal.REQUIRED_FIELDS:
+        if field not in rec:
+            problems.append(f"{event}: missing required field {field!r}")
+    for k, v in rec.items():
+        if not isinstance(v, _SCALARS):
+            problems.append(
+                f"{event}: field {k!r} is {type(v).__name__}, not a "
+                f"JSON scalar")
+        if isinstance(v, float) and not math.isfinite(v):
+            problems.append(f"{event}: field {k!r} is non-finite ({v})")
+    return problems
+
+
+def run() -> list:
+    """-> [problem] across all sample records (compat with the old tool)."""
+    from trino_tpu.telemetry import journal
+
+    problems = []
+    records = journal.sample_records()
+    if not records:
+        return ["journal.sample_records() returned no records"]
+    events = {r.get("event") for r in records}
+    for required in ("query_created", "query_completed"):
+        if required not in events:
+            problems.append(f"no sample record for event {required!r}")
+    for rec in records:
+        problems.extend(lint_record(rec))
+    return problems
+
+
+def check(index: ProjectIndex) -> list:
+    import sys
+
+    if index.root not in sys.path:
+        sys.path.insert(0, index.root)
+    try:
+        problems = run()
+    except Exception as e:  # import/sample failure IS a finding, not a crash
+        problems = [f"journal schema check failed to run: "
+                    f"{type(e).__name__}: {e}"]
+    return [Finding(NAME, JOURNAL_REL, 0, p) for p in problems]
+
+
+def main() -> int:
+    from . import rule_main
+    return rule_main(NAME, epilogue="fix the record factory in "
+                     "trino_tpu/telemetry/journal.py")
+
+
+RULES = [Rule(NAME, "journal records JSON round-trip with versioned "
+              "schema and scalar fields", check)]

@@ -1,0 +1,122 @@
+"""knob-registry / knob-docs: every TRINO_TPU_* knob is declared and
+documented.
+
+The engine reads ~45 ``TRINO_TPU_*`` env knobs; before the registry
+(trino_tpu/spi/knobs.py) each was declared nowhere but its read site, so a
+typo'd name silently fell back to the default and nothing enumerated what
+operators can tune.  Two rules hold the line:
+
+**knob-registry** — any string literal in the tree that *is* a knob name
+(full match of ``TRINO_TPU_[A-Z0-9_]+``) must be declared in the registry.
+This catches undeclared additions, misspellings (``TRINO_TPU_PREFECTH``),
+and dynamically-concatenated prefixes (a literal ending in ``_`` fails the
+exact-name lookup).  tests/ are scanned too: a test monkeypatching a
+misspelled knob silently tests nothing.
+
+**knob-docs** — docs/KNOBS.md must equal a fresh render from the registry
+byte-for-byte (``python -m tools.analysis --write-knob-docs``), so docs
+cannot drift stale or carry hand edits.
+
+Both read the registry with ``ast`` — no trino_tpu import, no jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding, ProjectIndex
+from ..knobdocs import DOCS_REL, KNOBS_REL, extract, render
+from . import Rule
+
+NAME = "knob-registry"
+DOCS_NAME = "knob-docs"
+
+KNOB_LITERAL = re.compile(r"^TRINO_TPU_[A-Z0-9_]+$")
+# the registry declares knobs; the docs generator/check lives off-tree
+EXEMPT = (KNOBS_REL,)
+
+
+def _declared(index: ProjectIndex) -> set:
+    try:
+        return {name for name, *_ in extract(index.root)}
+    except (OSError, ValueError, SyntaxError):
+        return set()
+
+
+def check(index: ProjectIndex) -> list:
+    declared = _declared(index)
+    findings = []
+    if not declared:
+        findings.append(Finding(
+            NAME, KNOBS_REL, 0,
+            "knob registry missing or unreadable — every TRINO_TPU_* knob "
+            "must be declared in trino_tpu/spi/knobs.py"))
+        return findings
+    for sf in index.iter_files():
+        if sf.rel in EXEMPT or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and KNOB_LITERAL.match(node.value)):
+                continue
+            if node.value in declared:
+                continue
+            findings.append(Finding(
+                NAME, sf.rel, node.lineno,
+                f"undeclared env knob {node.value!r} — declare it in "
+                f"trino_tpu/spi/knobs.py (typo? nearest declared: "
+                f"{_nearest(node.value, declared)})",
+                sf.line(node.lineno).strip()))
+    return findings
+
+
+def _nearest(name: str, declared: set) -> str:
+    """Cheap typo hint: declared knob sharing the longest common prefix."""
+    best, best_len = "<none>", -1
+    for d in sorted(declared):
+        n = len(os.path.commonprefix([name, d]))
+        if n > best_len:
+            best, best_len = d, n
+    return best
+
+
+def check_docs(index: ProjectIndex) -> list:
+    try:
+        expected = render(extract(index.root))
+    except (OSError, ValueError, SyntaxError) as e:
+        return [Finding(DOCS_NAME, KNOBS_REL, 0,
+                        f"knob registry unreadable for docs check: {e}")]
+    path = os.path.join(index.root, DOCS_REL)
+    if not os.path.exists(path):
+        return [Finding(DOCS_NAME, DOCS_REL, 0,
+                        "docs/KNOBS.md missing — generate it with "
+                        "'python -m tools.analysis --write-knob-docs'")]
+    with open(path, encoding="utf-8") as f:
+        actual = f.read()
+    if actual != expected:
+        # name the first drifted knob row for a human-sized message
+        exp_lines, act_lines = expected.splitlines(), actual.splitlines()
+        detail = "content differs"
+        for i, (e, a) in enumerate(zip(exp_lines, act_lines), 1):
+            if e != a:
+                detail = f"first drift at line {i}: {a[:60]!r} != {e[:60]!r}"
+                break
+        else:
+            detail = (f"line count {len(act_lines)} != {len(exp_lines)} "
+                      f"(knob added or removed without regenerating)")
+        return [Finding(DOCS_NAME, DOCS_REL, 0,
+                        f"docs/KNOBS.md is stale vs the registry ({detail})"
+                        " — regenerate with 'python -m tools.analysis "
+                        "--write-knob-docs'")]
+    return []
+
+
+RULES = [
+    Rule(NAME, "every TRINO_TPU_* string literal names a registry-declared "
+         "knob", check),
+    Rule(DOCS_NAME, "docs/KNOBS.md matches a fresh render of the knob "
+         "registry", check_docs),
+]

@@ -1,0 +1,155 @@
+"""metric-names: every registered metric name is Prometheus-legal.
+
+AST successor of the grep lint tools/lint_metric_names.py.  The telemetry
+registry (trino_tpu/telemetry/metrics.py) validates names at registration
+time, but a misnamed metric in a lazily-imported module only blows up when
+that code path first runs — long after CI went green.  This rule finds
+every ``REGISTRY.counter("...")`` / ``.gauge("...")`` /
+``.distribution("...")`` site statically (line-wrapped or not — the AST
+does not care) and enforces:
+
+- names match the Prometheus data model (``[a-zA-Z_:][a-zA-Z0-9_:]*``)
+- every name carries the mandatory ``trino_`` prefix
+- counters end in ``_total``
+- no metric name literal is registered at two distinct sites
+- the contractually-required families (profiler/journal/cache/adaptive
+  telemetry) each have at least one registration site
+
+A justified exception carries the legacy ``# metric-ok`` pragma or a
+``# tpulint: disable=metric-names`` directive.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding, ProjectIndex
+from . import Rule
+
+NAME = "metric-names"
+LEGAL = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+PREFIX = "trino_"
+SCAN_DIR = "trino_tpu"
+LEGACY_PRAGMA = "metric-ok"
+KINDS = ("counter", "gauge", "distribution")
+
+# metric families the observability plane is contractually expected to
+# expose (PR 11 flight recorder, PR 12 cache plane, PR 13 adaptive): at
+# least one registration of each must exist, so a refactor can't silently
+# drop that telemetry
+REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_",
+                     "trino_adaptive_")
+
+
+def _registrations(tree: ast.Module, lines: list) -> list:
+    """-> [(lineno, kind, name)] — every literal-named registration call,
+    minus lines carrying the legacy pragma."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in KINDS and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if LEGACY_PRAGMA in line:
+            continue
+        out.append((node.lineno, node.func.attr, node.args[0].value))
+    return out
+
+
+def _name_problems(kind: str, name: str) -> list:
+    if not LEGAL.match(name):
+        return ["illegal Prometheus metric name"]
+    problems = []
+    if not name.startswith(PREFIX):
+        problems.append(f"missing mandatory {PREFIX!r} prefix")
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append("counter name must end in '_total'")
+    return problems
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    sites: dict = {}                    # name -> [(rel, lineno)]
+    for sf in index.iter_files((SCAN_DIR + "/",)):
+        if sf.tree is None:
+            continue
+        for lineno, kind, name in _registrations(sf.tree, sf.lines):
+            sites.setdefault(name, []).append((sf.rel, lineno))
+            for problem in _name_problems(kind, name):
+                findings.append(Finding(NAME, sf.rel, lineno,
+                                        f"{name!r}: {problem}",
+                                        sf.line(lineno).strip()))
+    for name, where in sorted(sites.items()):
+        if len(where) > 1:
+            first = f"{where[0][0]}:{where[0][1]}"
+            for rel, lineno in where[1:]:
+                findings.append(Finding(
+                    NAME, rel, lineno,
+                    f"{name!r}: duplicate registration (first at {first})"))
+    for fam in REQUIRED_FAMILIES:
+        if not any(n.startswith(fam) for n in sites):
+            findings.append(Finding(
+                NAME, SCAN_DIR, 0,
+                f"required metric family {fam}* has no registration site"))
+    return findings
+
+
+# ----------------------------------------------------- legacy shim surface
+
+def lint_file(path: str) -> list:
+    """Compat: -> [(path, lineno, metric_name, problem)] for one file."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tree = ast.parse(text, filename=path)
+    findings = []
+    for lineno, kind, name in _registrations(tree, text.splitlines()):
+        for problem in _name_problems(kind, name):
+            findings.append((path, lineno, name, problem))
+    return findings
+
+
+def run(root: str, require_families: bool = False) -> list:
+    """Compat: filesystem-walking variant returning 4-tuples (naming +
+    duplicate checks; families opt-in like the old tool)."""
+    findings = []
+    sites: dict = {}
+    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            findings.extend(lint_file(path))
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for lineno, _kind, name in _registrations(
+                    ast.parse(text, filename=path), text.splitlines()):
+                sites.setdefault(name, []).append((path, lineno))
+    for name, where in sorted(sites.items()):
+        if len(where) > 1:
+            for path, lineno in where[1:]:
+                findings.append(
+                    (path, lineno, name,
+                     f"duplicate registration (first at "
+                     f"{where[0][0]}:{where[0][1]})"))
+    if require_families:
+        for fam in REQUIRED_FAMILIES:
+            if not any(n.startswith(fam) for n in sites):
+                findings.append(
+                    (os.path.join(root, SCAN_DIR), 0, fam + "*",
+                     "required metric family has no registration site"))
+    return findings
+
+
+def main() -> int:
+    from . import rule_main
+    return rule_main(NAME, epilogue="annotate justified exceptions with "
+                     f"# {LEGACY_PRAGMA}")
+
+
+RULES = [Rule(NAME, "metric registrations are Prometheus-legal, "
+              "trino_-prefixed, unique", check)]

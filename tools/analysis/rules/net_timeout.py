@@ -1,0 +1,95 @@
+"""net-timeout: no unbounded network waits in trino_tpu/execution/.
+
+AST successor of the grep lint tools/lint_net_timeout.py.  A ``urlopen``/
+socket call without an explicit ``timeout=`` blocks forever when the peer
+wedges — exactly the silent-stall class the resilience layer (spi/errors.py
+Backoff, execution/failure_detector.py) exists to eliminate.  The AST form
+sees the whole argument list at once, so multi-line calls and aliased
+imports need no balanced-paren heuristics, and a ``timeout`` passed
+positionally counts too.
+
+A justified exception carries the legacy ``# net-ok`` pragma or a
+``# tpulint: disable=net-timeout`` directive on the call line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ProjectIndex
+from . import Rule
+
+NAME = "net-timeout"
+SCAN_DIRS = ("trino_tpu/execution/",)
+LEGACY_PRAGMA = "net-ok"
+
+# callee name -> 0-based positional index where ``timeout`` may also appear
+# (urlopen(url, data, timeout), create_connection(addr, timeout),
+#  HTTPConnection(host, port, timeout))
+NETWORK_CALLS = {
+    "urlopen": 2,
+    "create_connection": 1,
+    "HTTPConnection": 2,
+    "HTTPSConnection": 2,
+}
+
+
+def _callee_name(call: ast.Call):
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _violations(tree: ast.Module, lines: list) -> list:
+    """-> [(lineno, label, source_line)] for one parsed module."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node)
+        if name not in NETWORK_CALLS:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if LEGACY_PRAGMA in line:
+            continue
+        has_kw = any(kw.arg == "timeout" for kw in node.keywords)
+        has_pos = len(node.args) > NETWORK_CALLS[name]
+        if not (has_kw or has_pos):
+            out.append((node.lineno, f"{name} without timeout",
+                        line.strip()))
+    return out
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    for sf in index.iter_files(SCAN_DIRS):
+        if sf.tree is None:
+            continue
+        for lineno, label, src in _violations(sf.tree, sf.lines):
+            findings.append(Finding(NAME, sf.rel, lineno, label, src))
+    return findings
+
+
+# ----------------------------------------------------- legacy shim surface
+
+def lint_file(path: str) -> list:
+    """Compat with the old tools/lint_net_timeout.py API:
+    -> [(path, lineno, label, source_line)]."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tree = ast.parse(text, filename=path)
+    return [(path, lineno, label, src)
+            for lineno, label, src in _violations(tree, text.splitlines())]
+
+
+def main() -> int:
+    from . import rule_main
+    return rule_main(NAME, epilogue="pass an explicit timeout= or justify "
+                     "with a '# net-ok' pragma")
+
+
+RULES = [Rule(NAME, "network calls in execution/ must carry a timeout",
+              check)]

@@ -1,0 +1,383 @@
+"""thread-safety: lock-consistency + lock-ordering for thread-shared classes.
+
+The concurrent runtime (heartbeat, prefetch, exchange buffers, resource
+manager, speculation) grew races that only the chaos soak caught *after*
+they shipped (PR 9 flushed three).  This rule catches the dominant class
+statically, RacerD-style, with two analyses:
+
+**Inconsistent locking.**  A class is *thread-shared* when its own code
+hands a bound method to ``threading.Thread(target=self._x)`` or an
+executor ``submit(self._x, ...)``, or when outside code spawns a thread on
+a method of an instance it just constructed.  Within a shared class, an
+attribute that is mutated at least once while holding one of the class's
+locks (``with self._lock:`` — any attr bound to ``threading.Lock / RLock /
+Condition``) is *lock-guarded*; any other mutation of that attribute
+outside a lock scope (excluding ``__init__``/``__del__``, which run before
+publication / after quiescence) is a finding.  The guarded-attr framing
+self-limits false positives: an attribute never locked anywhere is
+presumed single-threaded and never flagged.
+
+**Lock ordering.**  Every nested acquisition (``with self._a:`` then
+``with self._b:``, directly or through one level of self-method call)
+becomes an edge A->B in a lock-order graph over (class, lock-attr) and
+module-level lock nodes.  A cycle in that graph is a potential deadlock;
+one finding is emitted per cycle at its lexicographically-first edge.
+
+Suppress deliberate exceptions with ``# tpulint: disable=thread-safety --
+reason`` on the mutation line.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core import ClassInfo, Finding, ProjectIndex
+from . import Rule
+
+NAME = "thread-safety"
+SCAN = ("trino_tpu/",)
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# mutating container-method calls on an attribute count as writes to it
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "setdefault", "sort", "reverse",
+}
+
+
+def _is_lock_factory(call: ast.Call) -> bool:
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name in LOCK_FACTORIES
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@dataclass
+class _Mutation:
+    method: str
+    attr: str
+    lineno: int
+    locked: bool
+
+
+@dataclass
+class _ClassFacts:
+    info: ClassInfo
+    lock_attrs: set = field(default_factory=set)
+    spawned_methods: set = field(default_factory=set)   # evidence of sharing
+    mutations: list = field(default_factory=list)
+    # (held_key, acquired_key, lineno) nested-acquisition edges
+    lock_edges: list = field(default_factory=list)
+    # method name -> set of lock keys it acquires directly
+    acquires: dict = field(default_factory=dict)
+
+
+def _lock_key(cls_qual: str, attr: str) -> str:
+    return f"{cls_qual}.{attr}"
+
+
+class _MethodWalker:
+    """One pass over a method body tracking the held-lock stack."""
+
+    def __init__(self, facts: _ClassFacts, module_locks: dict, rel: str,
+                 method: str):
+        self.facts = facts
+        self.module_locks = module_locks        # name -> key
+        self.rel = rel
+        self.method = method
+        self.held: list = []
+
+    def _lock_key_for(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.facts.lock_attrs:
+            return _lock_key(self.facts.info.qualname, attr)
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return self.module_locks[expr.id]
+        return None
+
+    def walk_body(self, body: list) -> None:
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.With):
+            keys = []
+            for item in stmt.items:
+                key = self._lock_key_for(item.context_expr)
+                if key is not None:
+                    for held in self.held:
+                        if held != key:
+                            self.facts.lock_edges.append(
+                                (held, key, stmt.lineno))
+                    self.held.append(key)
+                    keys.append(key)
+                    self.facts.acquires.setdefault(self.method,
+                                                   set()).add(key)
+            for sub in stmt.body:
+                self.walk_stmt(sub)
+            for _ in keys:
+                self.held.pop()
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later (thread target / callback): its body
+            # does NOT inherit the currently-held locks
+            saved, self.held = self.held, []
+            for sub in stmt.body:
+                self.walk_stmt(sub)
+            self.held = saved
+            return
+
+        self._record_effects(stmt)
+
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.stmt):
+                self.walk_stmt(sub)
+
+    def _record_effects(self, stmt: ast.AST) -> None:
+        locked = bool(self.held)
+        targets: list = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        for t in targets:
+            base = t
+            if isinstance(base, ast.Subscript):
+                base = base.value           # self.a[k] = v mutates self.a
+            attr = _self_attr(base)
+            if attr is not None:
+                self.facts.mutations.append(
+                    _Mutation(self.method, attr, stmt.lineno, locked))
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            fn = call.func
+            if isinstance(fn, ast.Attribute):
+                # self.attr.append(...) — container mutation
+                attr = _self_attr(fn.value)
+                if attr is not None and fn.attr in MUTATOR_METHODS:
+                    self.facts.mutations.append(
+                        _Mutation(self.method, attr, stmt.lineno, locked))
+                # manual self._lock.acquire(): held for the rest of the
+                # method (coarse, errs toward fewer findings)
+                if fn.attr == "acquire":
+                    key = self._lock_key_for(fn.value)
+                    if key is not None:
+                        self.held.append(key)
+            # thread-spawn evidence
+            self._record_spawn(call)
+
+    def _record_spawn(self, call: ast.Call) -> None:
+        for m in _spawn_targets(call):
+            self.facts.spawned_methods.add(m)
+
+
+def _spawn_targets(call: ast.Call):
+    """Methods of ``self`` handed to a thread/executor by this call."""
+    fn = call.func
+    callee = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if callee == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                attr = _self_attr(kw.value)
+                if attr is not None:
+                    yield attr
+    elif callee == "submit" and call.args:
+        attr = _self_attr(call.args[0])
+        if attr is not None:
+            yield attr
+
+
+def _module_locks(tree: ast.Module, rel: str) -> dict:
+    """Top-level ``_LOCK = threading.Lock()`` bindings -> lock-node keys."""
+    out = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _is_lock_factory(node.value)):
+            out[node.targets[0].id] = f"{rel}::{node.targets[0].id}"
+    return out
+
+
+def _collect_lock_attrs(ci: ClassInfo) -> set:
+    attrs = set()
+    for fi in ci.methods.values():
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _is_lock_factory(node.value)):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        attrs.add(attr)
+    return attrs
+
+
+def _external_spawns(index: ProjectIndex) -> dict:
+    """Classes shared by *outside* code: ``obj = Cls(...)`` then
+    ``Thread(target=obj.m)`` / ``pool.submit(obj.m)`` in the same function.
+    -> {class qualname: {method, ...}}"""
+    shared: dict = {}
+    for q, fi in index.functions.items():
+        # local var -> class qualname for constructor calls
+        ctor: dict = {}
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                callee = index.resolve_call(fi.rel, fi, node.value)
+                if callee and callee.endswith(".__init__"):
+                    ctor[node.targets[0].id] = callee[:-len(".__init__")]
+                else:
+                    fn = node.value.func
+                    if isinstance(fn, ast.Name):
+                        local = f"{fi.rel}::{fn.id}"
+                        if local in index.classes:
+                            ctor[node.targets[0].id] = local
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            cands = []
+            if callee == "Thread":
+                cands = [kw.value for kw in node.keywords
+                         if kw.arg == "target"]
+            elif callee == "submit" and node.args:
+                cands = [node.args[0]]
+            for v in cands:
+                if (isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id in ctor):
+                    shared.setdefault(ctor[v.value.id],
+                                      set()).add(v.attr)
+    return shared
+
+
+def _find_cycles(edges: dict) -> list:
+    """-> list of cycles (each a list of node keys) via DFS; deterministic
+    order, each cycle reported once from its smallest node."""
+    cycles = []
+    seen_cycles = set()
+    nodes = sorted(edges)
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start:
+                cyc = tuple(path)
+                canon = min(tuple(cyc[i:] + cyc[:i]) for i in range(len(cyc)))
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(list(canon))
+            elif nxt not in on_path and nxt > start:
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for n in nodes:
+        dfs(n, n, [n], {n})
+    return cycles
+
+
+def check(index: ProjectIndex) -> list:
+    findings = []
+    ext_shared = _external_spawns(index)
+    all_edges: list = []        # (held, acquired, rel, lineno)
+
+    for cq in sorted(index.classes):
+        ci = index.classes[cq]
+        if not ci.rel.startswith(SCAN):
+            continue
+        sf = index.files[ci.rel]
+        if sf.tree is None:
+            continue
+        facts = _ClassFacts(ci)
+        facts.lock_attrs = _collect_lock_attrs(ci)
+        mlocks = _module_locks(sf.tree, ci.rel)
+        for mname in sorted(ci.methods):
+            fi = ci.methods[mname]
+            w = _MethodWalker(facts, mlocks, ci.rel, mname)
+            w.walk_body(fi.node.body)
+        facts.spawned_methods |= ext_shared.get(cq, set())
+
+        # one level of call-through for lock ordering: holding A, calling
+        # self.m() where m acquires B => A -> B
+        for mname in sorted(ci.methods):
+            fi = ci.methods[mname]
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.With):
+                    continue
+                held = [k for item in node.items
+                        for k in [_MethodWalker(facts, mlocks, ci.rel,
+                                                mname)._lock_key_for(
+                                                    item.context_expr)]
+                        if k is not None]
+                if not held:
+                    continue
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id == "self"):
+                        for acquired in facts.acquires.get(
+                                sub.func.attr, ()):
+                            for h in held:
+                                if h != acquired:
+                                    facts.lock_edges.append(
+                                        (h, acquired, sub.lineno))
+
+        for held, acq, lineno in facts.lock_edges:
+            all_edges.append((held, acq, ci.rel, lineno))
+
+        if not facts.spawned_methods or not facts.lock_attrs:
+            continue
+        guarded = {m.attr for m in facts.mutations if m.locked}
+        guarded -= facts.lock_attrs
+        evidence = ", ".join(sorted(facts.spawned_methods))
+        for m in facts.mutations:
+            if (m.attr in guarded and not m.locked
+                    and m.method not in ("__init__", "__del__")):
+                findings.append(Finding(
+                    NAME, ci.rel, m.lineno,
+                    f"unlocked mutation of lock-guarded attribute "
+                    f"'self.{m.attr}' in thread-shared class '{ci.name}' "
+                    f"(shared via thread target(s): {evidence}; attribute "
+                    f"is mutated under a lock elsewhere)",
+                    sf.line(m.lineno).strip()))
+
+    # lock-order cycles across everything recorded
+    graph: dict = {}
+    sites: dict = {}
+    for held, acq, rel, lineno in all_edges:
+        graph.setdefault(held, set()).add(acq)
+        sites.setdefault((held, acq), (rel, lineno))
+    for cyc in _find_cycles(graph):
+        ring = cyc + [cyc[0]]
+        edge = (ring[0], ring[1])
+        rel, lineno = sites[edge]
+        pretty = " -> ".join(_short(k) for k in ring)
+        findings.append(Finding(
+            NAME, rel, lineno,
+            f"lock-order cycle (potential deadlock): {pretty}"))
+    return findings
+
+
+def _short(key: str) -> str:
+    # "trino_tpu/x.py::Cls.attr" -> "Cls.attr"; module locks keep the name
+    return key.split("::", 1)[1] if "::" in key else key
+
+
+RULES = [Rule(NAME, "unlocked mutations of guarded state in thread-shared "
+              "classes; lock-order deadlock cycles", check)]

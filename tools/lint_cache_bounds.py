@@ -1,88 +1,26 @@
-#!/usr/bin/env python
-"""Grep-based lint: no new unbounded memoization outside the registry.
+#!/usr/bin/env python3
+"""Legacy entry point — the cache-bounds lint now lives in the tpulint
+framework (tools/analysis/rules/cache_bounds.py) as an AST rule over
+decorator lists and ``lru_cache(...)`` call forms.
 
-PR 12 centralized every jitted-program memo behind
-``trino_tpu/caching/executable_cache.jit_memo`` — bounded, observable via
-``system.runtime.caches``, evictable, and journaled for boot-time warming.
-An ad-hoc ``@lru_cache(maxsize=None)`` on a jit-wrapper builder silently
-reintroduces the pre-PR-12 failure mode: an invisible, unbounded pile of
-compiled executables that no memory accounting sees and no restart can
-re-warm.  This lint statically rejects the unbounded forms:
-
-- ``@lru_cache`` / ``@functools.lru_cache`` (bare decorator — unbounded)
-- ``lru_cache()`` / ``lru_cache(maxsize=None)``
-- ``@functools.cache`` / ``@cache`` (always unbounded)
-
-Bounded ``lru_cache(maxsize=N)`` is allowed — it can't grow without limit,
-only unobserved, and some non-jit uses (parsing, schema lookups) are fine.
-The registry module itself (caching/executable_cache.py) is exempt: the
-``TRINO_TPU_EXEC_CACHE=0`` kill switch intentionally falls back to the
-bit-for-bit legacy ``lru_cache(maxsize=None)`` there.  A justified
-exception elsewhere carries a ``# cache-ok`` pragma.
-
-Like tools/lint_metric_names.py this is deliberately dumb — regex over
-lines, no AST — so it runs in milliseconds and is obvious to extend.
-
-Run directly (``python tools/lint_cache_bounds.py``; exit 1 on findings)
-or via the tier-1 test tests/test_caching.py.
+This shim keeps the historical CLI (``python tools/lint_cache_bounds.py``)
+and module API (``lint_file``, ``run``) stable for tests/test_caching.py.
+Prefer ``python -m tools.analysis``.
 """
 
-from __future__ import annotations
-
 import os
-import re
 import sys
 
-# unbounded memo forms; bounded lru_cache(maxsize=N) deliberately passes
-UNBOUNDED = re.compile(
-    r"(?:functools\s*\.\s*)?lru_cache\s*\(\s*(?:maxsize\s*=\s*None\s*)?\)"
-    r"|@\s*(?:functools\s*\.\s*)?lru_cache\s*$"
-    r"|@\s*(?:functools\s*\.\s*)?cache\s*$")
-SCAN_DIR = "trino_tpu"
-EXEMPT = os.path.join("caching", "executable_cache.py")
-PRAGMA = "cache-ok"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-def lint_file(path: str) -> list[tuple[str, int, str]]:
-    """-> [(path, lineno, problem)] for one file."""
-    findings = []
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            if PRAGMA in line:
-                continue
-            if UNBOUNDED.search(line.rstrip()):
-                findings.append(
-                    (path, lineno,
-                     "unbounded memo cache — use "
-                     "caching.executable_cache.jit_memo (bounded, "
-                     "observable, warm-journaled) or lru_cache(maxsize=N)"))
-    return findings
-
-
-def run(root: str) -> list[tuple[str, int, str]]:
-    findings = []
-    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            if path.endswith(EXEMPT):
-                continue
-            findings.extend(lint_file(path))
-    return findings
-
-
-def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run(root)
-    for path, lineno, problem in findings:
-        rel = os.path.relpath(path, root)
-        print(f"{rel}:{lineno}: {problem}")
-    if findings:
-        print(f"{len(findings)} cache-bound violation(s)", file=sys.stderr)
-        return 1
-    return 0
-
+from tools.analysis.rules.cache_bounds import (  # noqa: E402,F401
+    EXEMPT,
+    lint_file,
+    main,
+    run,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

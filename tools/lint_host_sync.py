@@ -1,97 +1,31 @@
-#!/usr/bin/env python
-"""Grep-based lint: no raw device->host scalar syncs in the exec hot path.
+#!/usr/bin/env python3
+"""Legacy entry point — the host-sync lint now lives in the tpulint
+framework (tools/analysis/rules/host_sync.py), which adds a dataflow
+layer (implicit syncs on inferred device values reachable from SyncGuard
+hot regions) on top of the original grep patterns kept there verbatim.
 
-Blocking scalar materializations (``int(np.asarray(dev))``, ``.item()``,
-``bool(np.asarray(dev))`` ...) cost a full device round trip (~120 ms over a
-tunneled TPU) and dominated the r4 join profile when they hid inside
-per-batch operator code.  The sync-free rework routes every DELIBERATE host
-transfer through exec/syncguard.py (``SG.fetch`` / ``SG.async_scalar``) so
-it is counted, attributed to a tag, and forbidden inside hot regions under
-test enforcement.  This lint keeps raw patterns from creeping back into
-``trino_tpu/exec/`` and ``trino_tpu/ops/``.
-
-A line that is a justified exception carries a ``# sync-ok`` pragma (with a
-reason, ideally).  The SyncGuard module itself is exempt — it IS the
-sanctioned wrapper.
-
-Run directly (``python tools/lint_host_sync.py``; exit 1 on findings) or via
-the tier-1 test tests/test_sync_lint.py.
+This shim keeps the historical CLI (``python tools/lint_host_sync.py``)
+and module API (``PATTERNS``, ``lint_file``, ``run``) stable for
+tests/test_sync_lint.py.  Prefer ``python -m tools.analysis``.
 """
 
-from __future__ import annotations
-
 import os
-import re
 import sys
 
-# each pattern is (regex, human label); kept deliberately dumb — greppable,
-# no AST — so the lint runs in milliseconds and is obvious to extend
-PATTERNS: list[tuple[re.Pattern, str]] = [
-    (re.compile(r"\bint\(np\.asarray\("), "int(np.asarray(...)) blocking sync"),
-    (re.compile(r"\bbool\(np\.asarray\("),
-     "bool(np.asarray(...)) blocking sync"),
-    (re.compile(r"\bfloat\(np\.asarray\("),
-     "float(np.asarray(...)) blocking sync"),
-    (re.compile(r"\.item\(\)"), ".item() blocking sync"),
-    (re.compile(r"\bjax\.device_get\("), "raw jax.device_get (use SG.fetch)"),
-    (re.compile(r"block_until_ready\("),
-     "block_until_ready blocking sync (use SG.fetch / SG.async_scalar)"),
-]
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# parallel/ rides along: static_agg and the shard_map pipelines promise
-# sync-free bodies, so raw fetches there are as load-bearing a bug as in exec
-SCAN_DIRS = ("trino_tpu/exec", "trino_tpu/ops", "trino_tpu/parallel")
-# the fused-stage path promises ZERO host syncs between input deposit and
-# output take (SyncGuard hot_region asserted by tests/test_fused_stage.py),
-# and the collective exchange is its legacy twin — both scan file-by-file
-SCAN_FILES = ("trino_tpu/execution/stage_compiler.py",
-              "trino_tpu/execution/collective_exchange.py")
-EXEMPT_FILES = ("syncguard.py",)  # the sanctioned wrapper itself
-PRAGMA = "sync-ok"
-
-
-def lint_file(path: str) -> list[tuple[str, int, str, str]]:
-    findings = []
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            if PRAGMA in line:
-                continue
-            for pat, label in PATTERNS:
-                if pat.search(line):
-                    findings.append((path, lineno, label, line.strip()))
-    return findings
-
-
-def run(root: str) -> list[tuple[str, int, str, str]]:
-    findings = []
-    for d in SCAN_DIRS:
-        base = os.path.join(root, d)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            for fn in sorted(filenames):
-                if not fn.endswith(".py") or fn in EXEMPT_FILES:
-                    continue
-                findings.extend(lint_file(os.path.join(dirpath, fn)))
-    for rel in SCAN_FILES:
-        path = os.path.join(root, rel)
-        if os.path.exists(path):
-            findings.extend(lint_file(path))
-    return findings
-
-
-def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run(root)
-    for path, lineno, label, line in findings:
-        rel = os.path.relpath(path, root)
-        print(f"{rel}:{lineno}: {label}: {line}", file=sys.stderr)
-    if findings:
-        print(f"{len(findings)} raw host sync(s) in the exec hot path — "
-              "route them through exec/syncguard.py (SG.fetch / "
-              "SG.async_scalar) or justify with a '# sync-ok' pragma",
-              file=sys.stderr)
-        return 1
-    return 0
-
+from tools.analysis.rules.host_sync import (  # noqa: E402,F401
+    EXEMPT_FILES,
+    PATTERNS,
+    PRAGMA,
+    SCAN_DIRS,
+    SCAN_FILES,
+    lint_file,
+    main,
+    run,
+)
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

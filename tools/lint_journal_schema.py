@@ -1,92 +1,26 @@
-#!/usr/bin/env python
-"""Journal schema lint: every record the query journal can emit is sound.
+#!/usr/bin/env python3
+"""Legacy entry point — the journal-schema lint now lives in the tpulint
+framework (tools/analysis/rules/journal_schema.py).  Still the one
+dynamic rule: it imports trino_tpu/telemetry/journal.py and exercises
+``sample_records()`` because the schema contract lives in code.
 
-The durable query journal (trino_tpu/telemetry/journal.py) is read back by
-``system.runtime.query_history`` and by the admission estimator's restart
-seeding, so a record that doesn't round-trip through JSON — or drops the
-versioned ``schema`` field — corrupts consumers long after the write went
-green.  This lint materializes one representative record per event type
-(``journal.sample_records()``) and enforces the contract up front:
-
-- the record JSON-serializes AND parses back to an equal dict (no sets,
-  no raw dataclasses, no NaN round-trip surprises)
-- ``schema`` is present and equals ``journal.SCHEMA_VERSION`` (readers
-  key forward-compat decisions off it)
-- every ``journal.REQUIRED_FIELDS`` key is present
-- field values stay JSON-scalar (str/int/float/bool/None) — nested
-  containers would break the flat query_history column mapping
-
-Run directly (``python tools/lint_journal_schema.py``; exit 1 on findings)
-or via the tier-1 test in tests/test_journal.py.
+This shim keeps the historical CLI (``python tools/lint_journal_schema.py``)
+and module API (``lint_record``, ``run``) stable for
+tests/test_journal.py.  Prefer ``python -m tools.analysis``.
 """
 
-from __future__ import annotations
-
-import json
-import math
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-_SCALARS = (str, int, float, bool, type(None))
-
-
-def lint_record(rec: dict) -> list[str]:
-    problems = []
-    from trino_tpu.telemetry import journal
-
-    event = rec.get("event", "<unknown>")
-    try:
-        line = json.dumps(rec, allow_nan=False)
-    except (TypeError, ValueError) as e:
-        return [f"{event}: record does not JSON-serialize: {e}"]
-    back = json.loads(line)
-    if back != rec:
-        problems.append(f"{event}: record does not round-trip through JSON")
-    if rec.get("schema") != journal.SCHEMA_VERSION:
-        problems.append(
-            f"{event}: schema field is {rec.get('schema')!r}, expected "
-            f"{journal.SCHEMA_VERSION}")
-    for field in journal.REQUIRED_FIELDS:
-        if field not in rec:
-            problems.append(f"{event}: missing required field {field!r}")
-    for k, v in rec.items():
-        if not isinstance(v, _SCALARS):
-            problems.append(
-                f"{event}: field {k!r} is {type(v).__name__}, not a "
-                f"JSON scalar")
-        if isinstance(v, float) and not math.isfinite(v):
-            problems.append(f"{event}: field {k!r} is non-finite ({v})")
-    return problems
-
-
-def run() -> list[str]:
-    from trino_tpu.telemetry import journal
-
-    problems = []
-    records = journal.sample_records()
-    if not records:
-        return ["journal.sample_records() returned no records"]
-    events = {r.get("event") for r in records}
-    for required in ("query_created", "query_completed"):
-        if required not in events:
-            problems.append(f"no sample record for event {required!r}")
-    for rec in records:
-        problems.extend(lint_record(rec))
-    return problems
-
-
-def main() -> int:
-    problems = run()
-    for p in problems:
-        print(p)
-    if problems:
-        print(f"\n{len(problems)} journal schema violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
-
+from tools.analysis.rules.journal_schema import (  # noqa: E402,F401
+    lint_record,
+    main,
+    run,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,143 +1,28 @@
-#!/usr/bin/env python
-"""Grep-based lint: every registered metric name is Prometheus-legal.
+#!/usr/bin/env python3
+"""Legacy entry point — the metric-names lint now lives in the tpulint
+framework (tools/analysis/rules/metric_names.py) as an AST rule over
+``REGISTRY.counter/gauge/distribution`` call sites.
 
-The telemetry registry (trino_tpu/telemetry/metrics.py) validates names at
-registration time, but a misnamed metric in a lazily-imported module only
-blows up when that code path first runs — long after CI went green.  This
-lint finds every ``REGISTRY.counter("...")`` / ``.gauge("...")`` /
-``.distribution("...")`` registration site statically and enforces the
-naming scheme up front:
-
-- names match the Prometheus data model (``[a-zA-Z_:][a-zA-Z0-9_:]*``)
-- every name carries the mandatory ``trino_`` prefix (one flat namespace,
-  greppable across coordinator and worker scrapes)
-- counters end in ``_total`` (Prometheus counter convention; the registry
-  appends no suffix itself)
-- no metric name literal is registered at two distinct sites (two sites
-  silently sharing one cell is almost always a copy-paste bug; share the
-  module-level handle instead)
-
-A justified exception carries a ``# metric-ok`` pragma.  Like
-tools/lint_host_sync.py this is deliberately dumb — regex over lines, no
-AST — so it runs in milliseconds and is obvious to extend.
-
-Run directly (``python tools/lint_metric_names.py``; exit 1 on findings) or
-via the tier-1 test tests/test_metric_lint.py.
+This shim keeps the historical CLI (``python tools/lint_metric_names.py``)
+and module API (``lint_file``, ``run``) stable for
+tests/test_metric_lint.py.  Prefer ``python -m tools.analysis``.
 """
 
-from __future__ import annotations
-
 import os
-import re
 import sys
 
-# one registration site: .counter("name" / .gauge("name" / .distribution("name
-REGISTRATION = re.compile(
-    r"\.(?P<kind>counter|gauge|distribution)\(\s*[\"'](?P<name>[^\"']*)[\"']")
-LEGAL = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-PREFIX = "trino_"
-SCAN_DIR = "trino_tpu"
-PRAGMA = "metric-ok"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-def _logical_lines(path: str):
-    """(lineno, line) pairs, with a registration call split across the
-    black-style line break — ``REGISTRY.counter(`` then the name on the
-    next line — rejoined so the per-line regex still sees it."""
-    with open(path, encoding="utf-8") as f:
-        lines = f.readlines()
-    i = 0
-    while i < len(lines):
-        line = lines[i]
-        if line.rstrip().endswith("(") and i + 1 < len(lines):
-            yield i + 1, line.rstrip() + lines[i + 1].lstrip()
-            i += 2
-            continue
-        yield i + 1, line
-        i += 1
-
-
-def lint_file(path: str) -> list[tuple[str, int, str, str]]:
-    """-> [(path, lineno, metric_name, problem)] for one file."""
-    findings = []
-    for lineno, line in _logical_lines(path):
-        if PRAGMA in line:
-            continue
-        for m in REGISTRATION.finditer(line):
-            kind, name = m.group("kind"), m.group("name")
-            if not LEGAL.match(name):
-                findings.append((path, lineno, name,
-                                 "illegal Prometheus metric name"))
-            elif not name.startswith(PREFIX):
-                findings.append((path, lineno, name,
-                                 f"missing mandatory {PREFIX!r} prefix"))
-            elif kind == "counter" and not name.endswith("_total"):
-                findings.append((path, lineno, name,
-                                 "counter name must end in '_total'"))
-    return findings
-
-
-def registrations(root: str) -> dict[str, list[tuple[str, int]]]:
-    """metric name -> [(path, lineno)] across the tree (duplicate check)."""
-    sites: dict[str, list[tuple[str, int]]] = {}
-    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            for lineno, line in _logical_lines(path):
-                if PRAGMA in line:
-                    continue
-                for m in REGISTRATION.finditer(line):
-                    sites.setdefault(m.group("name"), []).append(
-                        (path, lineno))
-    return sites
-
-
-# metric families the observability plane is contractually expected to
-# expose (PR 11 flight recorder, PR 12 cache plane): at least one
-# registration of each must exist, so a refactor can't silently drop the
-# profiler/journal/cache telemetry
-REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_",
-                     "trino_adaptive_")
-
-
-def run(root: str, require_families: bool = False
-        ) -> list[tuple[str, int, str, str]]:
-    findings = []
-    for dirpath, _dirs, files in os.walk(os.path.join(root, SCAN_DIR)):
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                findings.extend(lint_file(os.path.join(dirpath, fn)))
-    sites_by_name = registrations(root)
-    for name, sites in sorted(sites_by_name.items()):
-        if len(sites) > 1:
-            for path, lineno in sites[1:]:
-                findings.append((path, lineno, name,
-                                 f"duplicate registration (first at "
-                                 f"{sites[0][0]}:{sites[0][1]})"))
-    if require_families:
-        for fam in REQUIRED_FAMILIES:
-            if not any(n.startswith(fam) for n in sites_by_name):
-                findings.append(
-                    (os.path.join(root, SCAN_DIR), 0, fam + "*",
-                     "required metric family has no registration site"))
-    return findings
-
-
-def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run(root, require_families=True)
-    for path, lineno, name, problem in findings:
-        rel = os.path.relpath(path, root)
-        print(f"{rel}:{lineno}: {name!r}: {problem}")
-    if findings:
-        print(f"\n{len(findings)} metric naming violation(s); "
-              f"annotate justified exceptions with  # {PRAGMA}",
-              file=sys.stderr)
-        return 1
-    return 0
-
+from tools.analysis.rules.metric_names import (  # noqa: E402,F401
+    LEGAL,
+    PREFIX,
+    REQUIRED_FAMILIES,
+    lint_file,
+    main,
+    run,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

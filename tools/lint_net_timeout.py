@@ -1,106 +1,26 @@
-#!/usr/bin/env python
-"""Grep-based lint: no unbounded network waits in trino_tpu/execution/.
+#!/usr/bin/env python3
+"""Legacy entry point — the net-timeout lint now lives in the tpulint
+framework (tools/analysis/rules/net_timeout.py) as an AST rule: it sees
+whole argument lists (multi-line calls, positional timeouts) instead of
+balanced-paren text heuristics.
 
-A ``urlopen``/socket call without an explicit ``timeout=`` blocks forever
-when the peer wedges — exactly the silent-stall class the resilience layer
-(spi/errors.py Backoff, execution/failure_detector.py) exists to eliminate.
-This lint keeps timeout-less network calls from regressing into the
-coordinator/worker execution code.
-
-A call site is flagged when the call's argument span (the balanced-paren
-region starting at the call, capped at a few lines) contains no ``timeout``
-keyword.  A justified exception carries a ``# net-ok`` pragma on the call
-line (with a reason, ideally).
-
-Run directly (``python tools/lint_net_timeout.py``; exit 1 on findings) or
-via the tier-1 test tests/test_net_lint.py.
+This shim keeps the historical CLI (``python tools/lint_net_timeout.py``)
+and module API (``lint_file``) stable for tests/test_net_lint.py.
+Prefer ``python -m tools.analysis``.
 """
 
-from __future__ import annotations
-
 import os
-import re
 import sys
 
-# each pattern opens a network call whose argument span must name a timeout;
-# deliberately dumb — greppable, no AST — so the lint runs in milliseconds
-PATTERNS: list[tuple[re.Pattern, str]] = [
-    (re.compile(r"\burlopen\s*\("), "urlopen without timeout"),
-    (re.compile(r"\bsocket\.create_connection\s*\("),
-     "socket.create_connection without timeout"),
-    (re.compile(r"\bHTTPConnection\s*\("), "HTTPConnection without timeout"),
-    (re.compile(r"\bHTTPSConnection\s*\("),
-     "HTTPSConnection without timeout"),
-]
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-SCAN_DIRS = ("trino_tpu/execution",)
-PRAGMA = "net-ok"
-# how many lines a call's argument list may span before we give up and flag
-MAX_CALL_SPAN = 10
-
-
-def _call_span(lines: list[str], lineno: int, col: int) -> str:
-    """The text from the call's opening paren to its balanced close (or the
-    span cap) — the region a ``timeout=`` keyword must appear in."""
-    depth = 0
-    chunks = []
-    for i in range(lineno - 1, min(lineno - 1 + MAX_CALL_SPAN, len(lines))):
-        text = lines[i][col:] if i == lineno - 1 else lines[i]
-        for j, ch in enumerate(text):
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-                if depth == 0:
-                    chunks.append(text[:j + 1])
-                    return "".join(chunks)
-        chunks.append(text)
-        col = 0
-    return "".join(chunks)
-
-
-def lint_file(path: str) -> list[tuple[str, int, str, str]]:
-    findings = []
-    with open(path, encoding="utf-8") as f:
-        lines = f.read().splitlines()
-    for lineno, line in enumerate(lines, 1):
-        if PRAGMA in line:
-            continue
-        for pat, label in PATTERNS:
-            m = pat.search(line)
-            if m is None:
-                continue
-            span = _call_span(lines, lineno, m.start())
-            if "timeout" not in span:
-                findings.append((path, lineno, label, line.strip()))
-    return findings
-
-
-def run(root: str) -> list[tuple[str, int, str, str]]:
-    findings = []
-    for d in SCAN_DIRS:
-        base = os.path.join(root, d)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            for fn in sorted(filenames):
-                if not fn.endswith(".py"):
-                    continue
-                findings.extend(lint_file(os.path.join(dirpath, fn)))
-    return findings
-
-
-def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run(root)
-    for path, lineno, label, line in findings:
-        rel = os.path.relpath(path, root)
-        print(f"{rel}:{lineno}: {label}: {line}", file=sys.stderr)
-    if findings:
-        print(f"{len(findings)} unbounded network call(s) in "
-              "trino_tpu/execution/ — pass an explicit timeout= or justify "
-              "with a '# net-ok' pragma", file=sys.stderr)
-        return 1
-    return 0
-
+from tools.analysis.rules.net_timeout import (  # noqa: E402,F401
+    NETWORK_CALLS,
+    lint_file,
+    main,
+)
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main())

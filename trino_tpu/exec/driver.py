@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import Optional, Sequence
 
+from ..spi.errors import GENERIC_INTERNAL_ERROR, TrinoError
 from ..telemetry import profiler
 from .operators import Operator
 from .stats import OperatorStats, PipelineStats, QueryStats, ScanIngestStats
@@ -67,7 +68,8 @@ class Driver:
             if status == "blocked":
                 stuck = [type(o).__name__ for o in self.operators
                          if not o.is_finished()]
-                raise RuntimeError(f"driver stalled; unfinished: {stuck}")
+                raise TrinoError(GENERIC_INTERNAL_ERROR,
+                                 f"driver stalled; unfinished: {stuck}")
 
     def process(self, deadline: float = float("inf")) -> str:
         """One scheduling quantum: move pages until ``deadline`` (a

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import ops as _ops  # noqa: F401  (enables jax x64 lanes)
+from ..spi.errors import GENERIC_INTERNAL_ERROR, TrinoError
 
 __all__ = [
     "bucket",
@@ -327,7 +328,8 @@ def hash_group_ids(keys: Sequence[tuple], live=None) -> tuple:
     order-canonicalized downstream.  The expensive multi-key 64-bit lexsort
     becomes one int32 sort over the kernel-assigned ids."""
     if not keys:
-        raise ValueError("hash_group_ids needs at least one key")
+        raise TrinoError(GENERIC_INTERNAL_ERROR,
+                         "hash_group_ids needs at least one key")
     n = int(jnp.asarray(keys[0][0]).shape[0])
     if n == 0:
         return jnp.arange(0), jnp.zeros(0, jnp.int32), 0

@@ -31,6 +31,7 @@ import numpy as np
 from ..ops.expr import compile_expression
 from ..sql.analyzer import STAT_AGGS
 from ..spi.batch import Column, ColumnBatch, pad_to_bucket, unify_dictionaries
+from ..spi.errors import SUBQUERY_MULTIPLE_ROWS, TrinoError
 from ..spi.connector import Connector, ConnectorPageSink, Split
 from ..spi.types import BIGINT, BOOLEAN, DOUBLE, DecimalType, Type, is_string
 from ..sql.ir import RowExpression
@@ -1518,7 +1519,8 @@ class LookupJoinOperator(Operator):
         nb = build.num_rows
         self._dense_build = build  # epilogue indexes match this batch
         if self.join_type == "SINGLE" and nb > 1 and probe.num_rows:
-            raise RuntimeError("scalar subquery returned multiple rows")
+            raise TrinoError(SUBQUERY_MULTIPLE_ROWS,
+                             "scalar subquery returned multiple rows")
         pi, bi = _nested_loop_pairs(probe, build, self.residual)
         if self.join_type in ("RIGHT", "FULL"):
             if self._build_matched is None:
@@ -1654,7 +1656,8 @@ class LookupJoinOperator(Operator):
                 need_matched)
             if self.join_type == "SINGLE" and int(
                     SG.fetch(res[3], "join.single-maxc")) > 1:
-                raise RuntimeError("scalar subquery returned multiple rows")
+                raise TrinoError(SUBQUERY_MULTIPLE_ROWS,
+                                 "scalar subquery returned multiple rows")
             commit(res)
             return
 

@@ -43,6 +43,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..spi.batch import Column, ColumnBatch, round_up_pow2, unify_dictionaries
+from ..spi.errors import GENERIC_INTERNAL_ERROR, TrinoError
 from .stats import ScanIngestStats
 
 __all__ = [
@@ -244,7 +245,8 @@ class PrefetchingPageSource:
             while True:
                 if self._error is not None:
                     err = self._error
-                    raise RuntimeError(
+                    raise TrinoError(
+                        GENERIC_INTERNAL_ERROR,
                         f"scan prefetch thread failed: {err}") from err
                 if self._closed:
                     return None

@@ -19,7 +19,16 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
-__all__ = ["parse_pattern", "PatternMatcher", "Match"]
+from ..spi.errors import GENERIC_INTERNAL_ERROR, TrinoError
+
+__all__ = ["parse_pattern", "PatternMatcher", "Match",
+           "PatternSyntaxError"]
+
+
+class PatternSyntaxError(ValueError):
+    """Malformed MATCH_RECOGNIZE pattern text — the query's own bug.
+    Registered in spi.errors._USER_ERROR_CLASS_NAMES so classify() maps it
+    to GENERIC_USER_ERROR (never retried), like AnalysisError/ParseError."""
 
 
 # --------------------------------------------------------------------------
@@ -61,7 +70,8 @@ class _PatternParser:
     def parse(self):
         e = self._alt()
         if self.cur is not None:
-            raise ValueError(f"unexpected pattern token {self.cur!r}")
+            raise PatternSyntaxError(
+                f"unexpected pattern token {self.cur!r}")
         return e
 
     def _alt(self):
@@ -76,7 +86,7 @@ class _PatternParser:
         while self.cur is not None and self.cur not in ("|", ")"):
             parts.append(self._quant())
         if not parts:
-            raise ValueError("empty pattern")
+            raise PatternSyntaxError("empty pattern")
         return parts[0] if len(parts) == 1 else PSeq(tuple(parts))
 
     def _quant(self):
@@ -105,7 +115,7 @@ class _PatternParser:
                     hi += self.cur
                     self.i += 1
             if self.cur != "}":
-                raise ValueError("unterminated {n,m} quantifier")
+                raise PatternSyntaxError("unterminated {n,m} quantifier")
             self.i += 1
             return PQuant(atom, int(lo or 0),
                           int(hi) if hi else None)
@@ -117,11 +127,11 @@ class _PatternParser:
             self.i += 1
             e = self._alt()
             if self.cur != ")":
-                raise ValueError("unbalanced ( in pattern")
+                raise PatternSyntaxError("unbalanced ( in pattern")
             self.i += 1
             return e
         if c is None or not (c[0].isalpha() or c[0] == "_"):
-            raise ValueError(f"expected pattern label, got {c!r}")
+            raise PatternSyntaxError(f"expected pattern label, got {c!r}")
         self.i += 1
         return PLabel(c.upper())
 
@@ -261,7 +271,8 @@ class PatternMatcher:
                 return None
 
             return rep(pos, 0)
-        raise TypeError(type(p).__name__)
+        raise TrinoError(GENERIC_INTERNAL_ERROR,
+                         f"unhandled pattern node {type(p).__name__}")
 
     def find_matches(self, n: int, skip_past_last: bool = True) -> list[Match]:
         """Scan a partition of ``n`` rows, emitting non-overlapping matches

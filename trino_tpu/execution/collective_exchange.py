@@ -34,7 +34,8 @@ from ..exec import kernels as K
 from ..exec import syncguard as SG
 from ..exec.operators import Operator, _concat_device
 from ..spi.batch import Column, ColumnBatch, unify_dictionaries
-from ..spi.errors import PAGE_TRANSPORT_TIMEOUT, TrinoError
+from ..spi.errors import (GENERIC_INTERNAL_ERROR, PAGE_TRANSPORT_TIMEOUT,
+                          TrinoError)
 
 __all__ = ["CollectiveRepartitionExchange", "CollectiveOutputSink",
            "CollectiveSourceOperator", "collectives_available"]
@@ -420,7 +421,10 @@ class CollectiveRepartitionExchange:
                 PAGE_TRANSPORT_TIMEOUT,
                 f"collective exchange stalled after {timeout:.0f}s")
         if self._error is not None:
-            raise RuntimeError(
+            if isinstance(self._error, TrinoError):
+                raise self._error      # keep the original classification
+            raise TrinoError(
+                GENERIC_INTERNAL_ERROR,
                 f"collective exchange failed: {self._error}") from self._error
         return self._results[task_index]
 

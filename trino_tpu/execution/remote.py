@@ -30,6 +30,8 @@ from typing import Optional
 from ..runner import QueryResult, Session
 from ..spi.batch import ColumnBatch
 from ..spi.errors import (
+    GENERIC_INTERNAL_ERROR,
+    GENERIC_USER_ERROR,
     NO_NODES_AVAILABLE,
     PAGE_TRANSPORT_TIMEOUT,
     REMOTE_HOST_GONE,
@@ -133,6 +135,7 @@ class HttpExchangeClient:
                 code_name = info.get("error_code")
                 error_type = info.get("error_type")
                 detail = info.get("error") or detail
+            # tpulint: disable=error-taxonomy -- best-effort payload parse; re-raised classified below
             except Exception:
                 pass
             raise TrinoError(
@@ -223,6 +226,7 @@ class HttpRemoteTask:
     def cancel(self) -> None:
         try:
             _http("DELETE", self.uri, timeout=5.0).read()
+        # tpulint: disable=error-taxonomy -- best-effort cancel of a task that may already be gone
         except Exception:
             pass
 
@@ -269,12 +273,14 @@ class WorkerProcess:
             try:
                 self.proc.kill()
                 self.proc.wait(timeout=10)
+            # tpulint: disable=error-taxonomy -- cleanup before the classified boot-failure raise below
             except Exception:
                 pass
             reader.join(timeout=5)
             why = ("timed out after "
                    f"{boot_timeout_s}s" if line is None else f"got {line!r}")
-            raise RuntimeError(
+            raise TrinoError(
+                REMOTE_HOST_GONE,
                 f"worker failed to boot ({why}); stderr: "
                 f"{self.stderr_tail()!r}")
         self.port = int(line.split()[1])
@@ -300,6 +306,7 @@ class WorkerProcess:
     def shutdown(self) -> None:
         try:
             _http("PUT", f"{self.url}/v1/shutdown", timeout=5.0).read()
+        # tpulint: disable=error-taxonomy -- best-effort graceful stop; kill() below is the backstop
         except Exception:
             pass
         try:
@@ -398,6 +405,7 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
             try:
                 if w.alive():
                     w.kill()
+            # tpulint: disable=error-taxonomy -- replaced worker teardown is best-effort
             except Exception:
                 pass
 
@@ -432,7 +440,8 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         if isinstance(worker, str):
             matches = [w for w in self.workers if w.url == worker]
             if not matches:
-                raise KeyError(f"no such worker: {worker}")
+                raise TrinoError(GENERIC_USER_ERROR,
+                                 f"no such worker: {worker}")
             w = matches[0]
         else:
             w = worker
@@ -443,8 +452,9 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         try:
             _http("PUT", f"{w.url}/v1/shutdown?timeout_s={budget:g}",
                   timeout=5.0).read()
+        # tpulint: disable=error-taxonomy -- already dead: the sweeps below classify it
         except Exception:
-            pass  # already dead: the sweeps below classify it
+            pass
         # observe SHUTTING_DOWN promptly so placement excludes the worker
         # from this moment on, not from the next opportunistic sweep
         self.failure_detector.sweep_once()
@@ -492,6 +502,7 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
             for w in self.workers:
                 if w.alive():
                     w.proc.kill()
+        # tpulint: disable=error-taxonomy -- interpreter-teardown kill; nothing to classify to
         except Exception:
             pass
 
@@ -563,8 +574,9 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
             time.sleep(0.05)
         expected = _os.path.join(task_dir, f"attempt-{attempt}")
         if not _os.path.isdir(expected):
-            raise RuntimeError("attempt reported FINISHED but no committed "
-                               "spool found")
+            raise TrinoError(GENERIC_INTERNAL_ERROR,
+                             "attempt reported FINISHED but no committed "
+                             "spool found")
         if stats_sink is not None:
             from ..exec.stats import QueryStats
 

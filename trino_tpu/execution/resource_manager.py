@@ -451,7 +451,8 @@ def estimate_peak_memory(fingerprint: str, default_bytes: int,
         seeded = tj.seeded_peak(fingerprint, history)
         if seeded > 0:
             return seeded
-    except Exception:  # noqa: BLE001 — journal trouble never blocks admission
+    # tpulint: disable=error-taxonomy -- journal trouble never blocks admission
+    except Exception:  # noqa: BLE001
         pass
     return default_bytes
 

@@ -58,7 +58,8 @@ from ..parallel.compat import shard_map
 from ..parallel.static_agg import AggSpec, combine_partials, static_grouped_agg
 from ..planner import plan as PL
 from ..spi.batch import Column, ColumnBatch
-from ..spi.errors import PAGE_TRANSPORT_TIMEOUT, TrinoError
+from ..spi.errors import (GENERIC_INTERNAL_ERROR, PAGE_TRANSPORT_TIMEOUT,
+                          TrinoError)
 from ..spi.types import DOUBLE, DecimalType
 
 __all__ = ["FusedStageExec", "FusedStageOverflow", "FusedStageSinkOperator",
@@ -782,9 +783,10 @@ class FusedStageExec:
                 f"fused stage seam f{self.spec.producer_fid}->"
                 f"f{self.spec.consumer_fid} stalled after {timeout:.0f}s")
         if self._error is not None:
-            if isinstance(self._error, FusedStageOverflow):
+            if isinstance(self._error, (FusedStageOverflow, TrinoError)):
                 raise self._error
-            raise RuntimeError(
+            raise TrinoError(
+                GENERIC_INTERNAL_ERROR,
                 f"fused stage failed: {self._error}") from self._error
         return self._results[task_index]
 

@@ -33,7 +33,8 @@ from typing import Callable, Optional
 __all__ = [
     "USER", "INTERNAL", "EXTERNAL", "INSUFFICIENT_RESOURCES", "ERROR_TYPES",
     "ErrorCode", "TrinoError", "Backoff",
-    "GENERIC_USER_ERROR", "GENERIC_INTERNAL_ERROR", "REMOTE_TASK_ERROR",
+    "GENERIC_USER_ERROR", "SUBQUERY_MULTIPLE_ROWS",
+    "GENERIC_INTERNAL_ERROR", "REMOTE_TASK_ERROR",
     "REMOTE_HOST_GONE", "PAGE_TRANSPORT_TIMEOUT", "PAGE_TRANSPORT_ERROR",
     "EXCEEDED_MEMORY_LIMIT_CODE", "NO_NODES_AVAILABLE",
     "QUERY_QUEUE_FULL", "QUERY_QUEUED_TIMEOUT", "CLUSTER_OUT_OF_MEMORY",
@@ -74,6 +75,9 @@ class ErrorCode:
 GENERIC_USER_ERROR = ErrorCode("GENERIC_USER_ERROR", 0x0000, USER)
 SYNTAX_ERROR = ErrorCode("SYNTAX_ERROR", 0x0001, USER)
 DIVISION_BY_ZERO = ErrorCode("DIVISION_BY_ZERO", 0x0008, USER)
+# a scalar subquery yielding >1 row is the query's own cardinality bug
+# (reference: StandardErrorCode SUBQUERY_MULTIPLE_ROWS) — USER, never retried
+SUBQUERY_MULTIPLE_ROWS = ErrorCode("SUBQUERY_MULTIPLE_ROWS", 0x0019, USER)
 # admission rejections are USER on purpose: re-submitting an identical query
 # into the same full queue re-fails identically, so the retry_policy=QUERY
 # loop must never burn attempts on them (reference: StandardErrorCode
@@ -101,7 +105,7 @@ REMOTE_HOST_GONE = ErrorCode("REMOTE_HOST_GONE", 0x3_0003, EXTERNAL)
 
 _CODES = {c.name: c for c in (
     GENERIC_USER_ERROR, SYNTAX_ERROR, DIVISION_BY_ZERO,
-    QUERY_QUEUE_FULL, QUERY_QUEUED_TIMEOUT,
+    SUBQUERY_MULTIPLE_ROWS, QUERY_QUEUE_FULL, QUERY_QUEUED_TIMEOUT,
     GENERIC_INTERNAL_ERROR, EXCEEDED_MEMORY_LIMIT_CODE, NO_NODES_AVAILABLE,
     CLUSTER_OUT_OF_MEMORY, EXCEEDED_GLOBAL_MEMORY_LIMIT,
     REMOTE_TASK_ERROR, PAGE_TRANSPORT_ERROR, PAGE_TRANSPORT_TIMEOUT,
@@ -151,6 +155,7 @@ _USER_ERROR_CLASS_NAMES = frozenset({
     "AnalysisError",     # sql/analyzer.py (ValueError subclass)
     "ParseError",        # sql/parser.py
     "QueryError",        # ops/expr.py deferred lane errors (DIVISION_BY_ZERO)
+    "PatternSyntaxError",  # exec/row_pattern.py MATCH_RECOGNIZE pattern text
 })
 _NETWORK_ERROR_TYPES = (ConnectionError, TimeoutError)
 

@@ -536,6 +536,57 @@ def _chaos_rolling_restart_drill() -> dict:
     }
 
 
+def run_fte_chaos_bench(write: bool = True) -> dict:
+    """``bench.py --chaos-fte`` (also appended to ``--chaos``): the FTE
+    chaos-certification leg for PR 15.  A seeded fault campaign over
+    ``retry_policy="TASK"`` — the streaming menu plus SPOOL_CORRUPTION
+    bit flips on committed spool files — followed by the coordinator
+    kill -9 drill: SIGKILL mid-query, restart, resume from the query-state
+    WAL with zero re-execution of committed attempts.  Acceptance is the
+    PR-9 bar (100%% of queries accounted, zero hangs) plus the drill's
+    ``pass``.  Writes BENCH_r15.json."""
+    n = int(os.environ.get("BENCH_FTE_CHAOS_SCENARIOS", "10"))
+    seed = int(os.environ.get("BENCH_FTE_CHAOS_SEED", "1515"))
+    _ensure_backend()
+    _enable_compile_cache()
+
+    from trino_tpu.telemetry.metrics import REGISTRY
+    from trino_tpu.testing.chaos import run_coordinator_kill_drill, run_fte_chaos
+
+    print(f"fte chaos leg: {n} scenarios from seed {seed}", file=sys.stderr)
+    t0 = time.perf_counter()
+    soak = run_fte_chaos(n_scenarios=n, base_seed=seed)
+    soak_wall = time.perf_counter() - t0
+    print("coordinator kill -9 drill", file=sys.stderr)
+    t0 = time.perf_counter()
+    drill = run_coordinator_kill_drill()
+    drill_wall = time.perf_counter() - t0
+    drill_out = {k: v for k, v in drill.items() if k != "rows"}
+    drill_out["n_rows"] = len(drill.get("rows") or [])
+
+    accounted = (soak["n_queries"] - soak["hangs"] - soak["unexpected"]
+                 ) / max(soak["n_queries"], 1)
+    result = {
+        "metric": f"fte_chaos_{n}_scenarios_accounted_fraction",
+        "value": round(accounted, 4),
+        "unit": "fraction of FTE queries oracle-correct or correctly "
+                "classified (target 1.0, zero hangs)",
+        "soak_wall_s": round(soak_wall, 1),
+        "drill_wall_s": round(drill_wall, 1),
+        "soak": soak,
+        "coordinator_kill_drill": drill_out,
+        "metrics": {k: v for k, v in REGISTRY.snapshot().items()
+                    if k.startswith("trino_fte_")},
+    }
+    print(json.dumps({k: v for k, v in result.items() if k != "soak"}))
+    if write:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r15.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
 def run_chaos_bench(write: bool = True) -> dict:
     """``bench.py --chaos``: the chaos-certification soak.  A seeded
     randomized fault-injection campaign (trino_tpu/testing/chaos.py) over
@@ -1402,8 +1453,12 @@ def main() -> None:
     if "--qps" in sys.argv:
         run_qps_bench()
         return
+    if "--chaos-fte" in sys.argv:
+        run_fte_chaos_bench()
+        return
     if "--chaos" in sys.argv:
         run_chaos_bench()
+        run_fte_chaos_bench()
         return
     if "--warm" in sys.argv:
         run_warm_bench()

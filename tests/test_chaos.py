@@ -365,3 +365,177 @@ def test_chaos_soak_full():
     assert summary["unexpected"] == 0
     assert summary["all_accounted"]
     assert summary["n_queries"] >= 25
+
+
+# ------------------------------------------- non-leaf speculation (ISSUE 15)
+def test_spool_tee_unit(tmp_path):
+    """StreamingSpoolTee + SpoolTeeBuffer: winner pages land durably in
+    FTE spool layout, a loser never reaches the tee, and ready() answers
+    twin eligibility only once EVERY source task committed."""
+    from trino_tpu.execution.serde import deserialize_batch, iter_frames
+    from trino_tpu.execution.speculation import (SpoolTeeBuffer,
+                                                 StreamingSpoolTee)
+    from trino_tpu.spi.batch import Column, ColumnBatch
+    from trino_tpu.spi.types import BIGINT
+
+    tee = StreamingSpoolTee(str(tmp_path))
+    tee.want(3, 2)
+    assert tee.wants(3) and not tee.wants(4)
+    assert not tee.ready([3])
+    assert tee.committed_dirs(3) is None
+
+    batch = ColumnBatch(["x"], [Column.from_values(BIGINT, [1, 2, 3])])
+    inner = OutputBuffer(1)
+    gate = TaskGate(on_claim=lambda k: None, on_finish=lambda k: None)
+    committed = []
+    win = SpoolTeeBuffer(GatedBuffer(inner, gate, STANDARD),
+                         tee.writer(3, 0, 1),
+                         on_commit=lambda d: (tee.mark_committed(3, 0, d),
+                                              committed.append(d)))
+    lose = SpoolTeeBuffer(GatedBuffer(inner, gate, SPECULATIVE),
+                          tee.writer(3, 0, 1, attempt=1000),
+                          on_commit=lambda d: tee.mark_committed(3, 0, d))
+    win.enqueue(0, batch)
+    with pytest.raises(SpeculationLost):
+        lose.enqueue(0, batch)  # gate rejects BEFORE the tee sees it
+    win.set_finished()
+    assert committed and committed[0].endswith("attempt-0")
+    assert not tee.ready([3])  # task 1 still missing
+
+    t1 = SpoolTeeBuffer(OutputBuffer(1), tee.writer(3, 1, 1),
+                        on_commit=lambda d: tee.mark_committed(3, 1, d))
+    t1.set_finished()
+    assert tee.ready([3]) and tee.ready([])
+    dirs = tee.committed_dirs(3)
+    assert [d.split("/")[-2] for d in dirs] == ["f3_t0", "f3_t1"]
+    # the committed tee holds exactly the winner's stream
+    with open(f"{dirs[0]}/part-0.bin", "rb") as f:
+        frames = list(iter_frames(f, "part-0.bin"))
+    assert len(frames) == 1
+    assert deserialize_batch(frames[0]).num_rows == 3
+
+
+def test_nonleaf_speculation_rescues_nonleaf_straggler(monkeypatch):
+    """The retention payoff (ROADMAP: 'non-leaf speculation needs FTE's
+    spool retention'): a TASK_STALL on a NON-leaf stage task — whose
+    inputs are ephemeral streaming exchanges — is rescued by a twin that
+    re-reads its producers' committed spool tees."""
+    monkeypatch.setenv("TRINO_TPU_FUSED_STAGE", "0")
+    from trino_tpu.caching import result_cache
+
+    sql = ("select l_returnflag, count(*), sum(l_quantity) from lineitem "
+           "group by l_returnflag order by l_returnflag")
+    inj = FailureInjector()
+    r = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=4,
+        session=Session(node_count=4, failure_injector=inj,
+                        speculation=True, speculation_nonleaf=True,
+                        use_collectives=False,
+                        speculation_lag_multiplier=1.2,
+                        speculation_min_delay_s=0.25))
+    frags = r.create_subplan(sql).all_fragments()
+    # the middle fragment: consumes the leaf scan, feeds the root output
+    mid = [f.id for f in frags if f.source_fragments
+           and any(f.id in g.source_fragments for g in frags)]
+    assert mid, "plan has no intermediate fragment"
+    inj.inject(TASK_STALL, fragment_id=mid[0], task_index=0, attempt=0,
+               stall_s=6.0)
+    with result_cache.disabled():
+        t0 = time.perf_counter()
+        rows = r.execute(sql).rows()
+        wall = time.perf_counter() - t0
+    baseline = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=4,
+        session=Session(node_count=4, use_collectives=False))
+    with result_cache.disabled():
+        assert rows == baseline.execute(sql).rows()
+    assert r.speculative_wins >= 1
+    wins = [e for e in r.resilience_events if e[0] == "speculative_win"]
+    assert any(e[1] == mid[0] for e in wins), wins
+    assert wall < 6.0, f"twin did not cut the stall ({wall:.1f}s)"
+
+
+def test_nonleaf_speculation_off_without_knob():
+    """Tri-state gating: session None + knob unset → non-leaf stages never
+    register for twins (leaf speculation is unaffected)."""
+    from trino_tpu.execution.speculation import nonleaf_speculation_enabled
+
+    assert not nonleaf_speculation_enabled(Session())
+    assert nonleaf_speculation_enabled(Session(speculation_nonleaf=True))
+    assert not nonleaf_speculation_enabled(
+        Session(speculation_nonleaf=False))
+
+
+# ------------------------------------------------- FTE chaos leg (ISSUE 15)
+def test_fte_spool_corruption_repaired():
+    """A bit-flipped committed spool file is detected (CRC), the attempt
+    discarded, and ONLY its producer re-run — oracle-correct rows out."""
+    from trino_tpu.caching import result_cache
+    from trino_tpu.execution.failure_injector import SPOOL_CORRUPTION
+    from trino_tpu.telemetry import metrics as tm
+
+    sql = ("select l_returnflag, count(*), sum(l_quantity) from lineitem "
+           "group by l_returnflag order by l_returnflag")
+    inj = FailureInjector()
+    r = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2, retry_policy="TASK",
+                        failure_injector=inj, task_retry_attempts=3))
+    inj.inject(SPOOL_CORRUPTION, fragment_id=None, task_index=0,
+               attempt=0, times=1)
+    before = tm.FTE_SPOOL_CORRUPTIONS.value()
+    with result_cache.disabled():
+        rows = r.execute(sql).rows()
+    assert tm.FTE_SPOOL_CORRUPTIONS.value() - before >= 1, \
+        "injected corruption was never detected"
+    baseline = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2, retry_policy="TASK"))
+    with result_cache.disabled():
+        assert rows == baseline.execute(sql).rows()
+
+
+def test_fte_chaos_smoke_fixed_seed():
+    """Tier-1 FTE chaos gate: one seeded scenario over the FTE fault menu
+    (task failure/stall/OOM, results-fetch failure, spool corruption) —
+    every query accounted, zero hangs.  Subprocess for the same XLA-
+    isolation reasons as test_chaos_smoke_fixed_seed."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from trino_tpu.testing.chaos import _ENV
+
+    prog = (
+        "import json\n"
+        "from trino_tpu.testing.chaos import build_expected, "
+        "run_fte_scenario\n"
+        "rec = run_fte_scenario(1515, n_queries=6,"
+        " expected=build_expected())\n"
+        "print(json.dumps({'counts': rec['counts'],"
+        " 'n': len(rec['outcomes'])}))\n"
+    )
+    env = {**os.environ, **_ENV}
+    out = subprocess.run([sys.executable, "-c", prog], env=env,
+                         capture_output=True, text=True, timeout=300,
+                         cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["n"] == 6
+    assert rec["counts"].get("hang", 0) == 0, "FTE chaos smoke hung"
+    assert rec["counts"].get("unexpected", 0) == 0, \
+        "FTE chaos smoke produced an unaccounted outcome"
+
+
+@pytest.mark.slow
+def test_fte_chaos_soak_full():
+    """The full FTE chaos leg (bench.py --chaos-fte writes the same
+    campaign + the coordinator kill drill to BENCH_r15.json)."""
+    from trino_tpu.testing.chaos import run_fte_chaos
+
+    summary = run_fte_chaos(n_scenarios=12, base_seed=1515, verbose=False)
+    assert summary["hangs"] == 0
+    assert summary["unexpected"] == 0
+    assert summary["all_accounted"]
+    assert summary["n_queries"] >= 12

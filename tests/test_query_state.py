@@ -1,0 +1,263 @@
+"""Coordinator crash recovery: the write-ahead query-state log
+(execution/query_state.py), in-process resume seeding, dispatcher boot
+recovery, and the subprocess kill -9 drill (reference:
+EventDrivenFaultTolerantQueryScheduler + the spooling exchange contract —
+committed attempts are never re-executed)."""
+
+import os
+
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.execution import query_state, spool_gc
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.runner import Session
+from trino_tpu.testing.oracle import SqliteOracle, assert_same_rows
+
+SQL = ("select l_returnflag, count(*), sum(l_quantity) from lineitem "
+       "group by l_returnflag order by l_returnflag")
+
+
+@pytest.fixture()
+def state_env(tmp_path, monkeypatch):
+    state = tmp_path / "query-state"
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    monkeypatch.setenv("TRINO_TPU_QUERY_STATE", "1")
+    monkeypatch.setenv("TRINO_TPU_QUERY_STATE_DIR", str(state))
+    monkeypatch.setenv("TRINO_TPU_SPOOL_DIR", str(spool))
+    return str(state), str(spool)
+
+
+# ------------------------------------------------------------- WAL unit
+def test_wal_lifecycle_and_load(tmp_path):
+    wal = query_state.QueryStateLog("q1", dir=str(tmp_path))
+    wal.begin("select 1", {"plan": 1}, "/spool/root", Session(),
+              task_counts={2: 2, 1: 2}, consumer_tasks={2: 2})
+    wal.attempt_start(2, 0, 0, "STANDARD")
+    wal.attempt_start(2, 1, 0, "STANDARD")
+    wal.attempt_committed(2, 1, 0, "/spool/root/f2_t1/attempt-0",
+                          "STANDARD")
+    wal.close()
+
+    pq = query_state.load(wal.path)
+    assert pq.query_id == "q1"
+    assert pq.sql == "select 1"
+    assert pq.resumable
+    assert pq.committed == {(2, 1): {
+        "attempt": 0, "dir": "/spool/root/f2_t1/attempt-0",
+        "kind": "STANDARD"}}
+    assert pq.attempt_counts == {(2, 0): 1, (2, 1): 1}
+    assert pq.fingerprint and pq.plan_b64
+    assert query_state.decode_plan(pq.plan_b64) == {"plan": 1}
+    assert pq.shape_matches({2: 2, 1: 2}, {2: 2})
+    assert not pq.shape_matches({2: 4, 1: 2}, {2: 2})
+
+    # terminal state flips resumable off; prune_ended removes the file
+    wal2 = query_state.QueryStateLog("q1", dir=str(tmp_path))
+    wal2.end("FINISHED")
+    wal2.close()
+    assert query_state.load(wal.path).ended == "FINISHED"
+    assert not query_state.load(wal.path).resumable
+    assert query_state.prune_ended(str(tmp_path)) == 1
+    assert not os.path.exists(wal.path)
+
+
+def test_wal_torn_tail_and_discard(tmp_path):
+    wal = query_state.QueryStateLog("q2", dir=str(tmp_path))
+    wal.begin("select 2", {"plan": 2}, "/s", Session())
+    wal.attempt_committed(0, 0, 0, "/s/f0_t0/attempt-0", "STANDARD")
+    wal.attempt_committed(1, 0, 0, "/s/f1_t0/attempt-0", "STANDARD")
+    wal.attempt_discarded(1, 0, "spool corruption")
+    wal.close()
+    # torn tail from a kill -9 mid-append: reader must skip it
+    with open(wal.path, "a", encoding="utf-8") as f:
+        f.write('{"event": "attempt_com')
+    pq = query_state.load(wal.path)
+    assert pq.resumable
+    # the discarded attempt is gone from the committed map
+    assert set(pq.committed) == {(0, 0)}
+    assert query_state.pending(str(tmp_path))[0].query_id == "q2"
+    query_state.discard("q2", str(tmp_path))
+    assert query_state.pending(str(tmp_path)) == []
+
+
+def test_restore_session_replays_only_known_fields(tmp_path):
+    wal = query_state.QueryStateLog("q3", dir=str(tmp_path))
+    wal.begin("select 3", {"plan": 3}, "/s",
+              Session(node_count=7, retry_policy="TASK",
+                      task_retry_attempts=9))
+    wal.close()
+    pq = query_state.load(wal.path)
+    pq.session_fields["not_a_field"] = "ignored"
+    sess = query_state.restore_session(pq)
+    assert sess.node_count == 7
+    assert sess.retry_policy == "TASK"
+    assert sess.task_retry_attempts == 9
+
+
+# -------------------------------------------- in-process crash + resume
+def _crashing_runner(state_env, inj=None, monkeypatch=None):
+    session = Session(node_count=2, retry_policy="TASK",
+                      failure_injector=inj, fte_speculative=False,
+                      task_retry_attempts=1)
+    return DistributedQueryRunner(default_catalog(scale_factor=0.01),
+                                  worker_count=2, session=session)
+
+
+def test_resume_skips_committed_attempts(state_env):
+    """Simulated coordinator death mid-FTE-query: fail the query after
+    some stages committed while suppressing the WAL's terminal record and
+    the spool release (exactly the state a kill -9 leaves behind), then
+    resume on a fresh runner — committed attempts must not re-execute."""
+    from trino_tpu.caching import result_cache
+    from trino_tpu.execution.failure_injector import (TASK_FAILURE,
+                                                      FailureInjector)
+
+    state_dir, _spool = state_env
+    inj = FailureInjector()
+    r1 = _crashing_runner(state_env, inj)
+    fragments = r1.create_subplan(SQL).all_fragments()
+    root_fid = [f.id for f in fragments if f.source_fragments]
+    # kill the FIRST non-leaf stage every attempt: leaves commit, the
+    # query dies with retries exhausted — like a coordinator crash, the
+    # WAL keeps its committed map (end/release suppressed below)
+    inj.inject(TASK_FAILURE, fragment_id=root_fid[-1], task_index=None,
+               attempt=None, times=10)
+    # a private MonkeyPatch so undo() below does NOT drop state_env's env
+    crash = pytest.MonkeyPatch()
+    crash.setattr(query_state.QueryStateLog, "end",
+                  lambda self, *a, **kw: None)
+    crash.setattr(spool_gc, "release", lambda root: 0)
+    try:
+        with result_cache.disabled():
+            with pytest.raises(Exception):
+                r1.execute(SQL)
+    finally:
+        crash.undo()
+
+    pending = query_state.pending(state_dir)
+    assert len(pending) == 1
+    pq = pending[0]
+    assert pq.resumable and len(pq.committed) >= 1
+    starts_before = dict(pq.attempt_counts)
+    committed = set(pq.committed)
+
+    r2 = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2, retry_policy="TASK"))
+    result = r2.resume_fte_query(pq)
+
+    oracle = SqliteOracle()
+    conn = default_catalog(scale_factor=0.01).connector("tpch")
+    cols = conn.get_table_schema("lineitem").column_names()
+    batches = []
+    for s in conn.get_splits("lineitem", 2, 1):
+        src = conn.create_page_source(s, cols)
+        while not src.is_finished():
+            b = src.get_next_batch()
+            if b is not None:
+                batches.append(b)
+    oracle.load_table("lineitem", batches)
+    assert_same_rows(result.rows(), oracle.query(SQL), ordered=False)
+
+    final = query_state.load(pq.path)
+    assert final.ended == "FINISHED"
+    for key in committed:
+        assert final.attempt_counts.get(key, 0) == \
+            starts_before.get(key, 0), \
+            f"committed attempt {key} was re-executed"
+    # the resumed run did execute what was NOT committed
+    assert any(final.attempt_counts.get(k, 0) > starts_before.get(k, 0)
+               for k in final.attempt_counts)
+
+
+def test_dispatcher_boot_recovery(state_env):
+    """QueryDispatcher must rehydrate in-flight WAL queries at boot under
+    their original ids so a reattaching client's polling resolves."""
+    from trino_tpu.caching import result_cache
+    from trino_tpu.execution.failure_injector import (TASK_FAILURE,
+                                                      FailureInjector)
+    from trino_tpu.server.protocol import QueryDispatcher
+
+    state_dir, _spool = state_env
+    inj = FailureInjector()
+    r1 = _crashing_runner(state_env, inj)
+    fragments = r1.create_subplan(SQL).all_fragments()
+    nonleaf = [f.id for f in fragments if f.source_fragments]
+    inj.inject(TASK_FAILURE, fragment_id=nonleaf[-1], task_index=None,
+               attempt=None, times=10)
+    crash = pytest.MonkeyPatch()
+    crash.setattr(query_state.QueryStateLog, "end",
+                  lambda self, *a, **kw: None)
+    crash.setattr(spool_gc, "release", lambda root: 0)
+    try:
+        with result_cache.disabled():
+            with pytest.raises(Exception):
+                r1.execute(SQL, query_id="deadbeef00000001")
+    finally:
+        crash.undo()
+
+    r2 = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2, retry_policy="TASK"))
+    disp = QueryDispatcher(r2)
+    assert disp.recovered_query_ids == ["deadbeef00000001"]
+    q = disp.get("deadbeef00000001")
+    assert q is not None and q.recovered
+    assert q.done.wait(120)
+    assert q.state == "FINISHED", q.error
+    assert len(q.rows) == 3  # A / N / R
+    # terminal WALs were pruned at boot; this query's WAL ends FINISHED
+    final = query_state.load(os.path.join(state_dir,
+                                          "deadbeef00000001.wal"))
+    assert final.ended == "FINISHED"
+
+
+# ------------------------------------------------- subprocess kill -9
+def test_coordinator_kill9_restart_resume(tmp_path):
+    """The tentpole acceptance: SIGKILL the coordinator process mid-FTE-
+    query, restart it, and the query finishes oracle-correct under its
+    original id with ZERO re-execution of committed attempts and the
+    spool root reclaimed."""
+    from trino_tpu.testing.chaos import _DRILL_SQL, run_coordinator_kill_drill
+
+    rec = run_coordinator_kill_drill(workdir=str(tmp_path))
+    assert rec["state"] == "FINISHED", rec.get("error")
+    assert rec["committed_at_kill"] >= 1
+    assert rec["committed_reexecuted"] == {}, \
+        "committed attempts were re-executed after the restart"
+    assert rec["resumed_attempt_starts"], \
+        "the resumed coordinator did no work at all"
+    assert rec["wal_ended"] == "FINISHED"
+    assert rec["spool_reclaimed"]
+    assert rec["pass"]
+
+    # oracle-correct rows through the reattached client surface
+    oracle = SqliteOracle()
+    conn = default_catalog(scale_factor=0.01).connector("tpch")
+    cols = conn.get_table_schema("lineitem").column_names()
+    batches = []
+    for s in conn.get_splits("lineitem", 2, 1):
+        src = conn.create_page_source(s, cols)
+        while not src.is_finished():
+            b = src.get_next_batch()
+            if b is not None:
+                batches.append(b)
+    oracle.load_table("lineitem", batches)
+    expected = oracle.query(_DRILL_SQL)
+    got = [tuple(row) for row in rec["rows"]]
+
+    def norm(rows):
+        out = []
+        for row in rows:
+            cells = []
+            for v in row:
+                try:  # "368805.00" (server JSON) vs 368805.0 (sqlite)
+                    cells.append(round(float(v), 2))
+                except (TypeError, ValueError):
+                    cells.append(str(v))
+            out.append(tuple(cells))
+        return sorted(out, key=str)
+
+    assert norm(got) == norm(expected)

@@ -1,0 +1,155 @@
+"""Spool retention/GC (execution/spool_gc.py) and CRC-checked v2 spool
+framing (execution/serde.py): leases, boot-sweep rules, byte budget, and
+corruption detection classified as retryable."""
+
+import os
+import struct
+
+import pytest
+
+from trino_tpu.execution import spool_gc
+from trino_tpu.execution.serde import (SPOOL_STREAM_MAGIC,
+                                       SpoolCorruptionError, iter_frames,
+                                       write_frame, write_frame_crc,
+                                       write_stream_header)
+
+
+# --------------------------------------------------------- CRC framing
+def test_v2_roundtrip_and_v1_autodetect(tmp_path):
+    pages = [b"alpha", b"", b"x" * 4096]
+    v2 = tmp_path / "v2.bin"
+    with open(v2, "wb") as f:
+        write_stream_header(f)
+        for p in pages:
+            write_frame_crc(f, p)
+    with open(v2, "rb") as f:
+        assert list(iter_frames(f, str(v2))) == pages
+
+    # pre-existing v1 files (no magic) stay readable through the same API
+    v1 = tmp_path / "v1.bin"
+    with open(v1, "wb") as f:
+        for p in pages:
+            write_frame(f, p)
+    with open(v1, "rb") as f:
+        assert list(iter_frames(f, str(v1))) == pages
+
+
+def test_v2_bit_flip_detected(tmp_path):
+    path = tmp_path / "flip.bin"
+    with open(path, "wb") as f:
+        write_stream_header(f)
+        write_frame_crc(f, b"payload-bytes")
+    raw = bytearray(path.read_bytes())
+    raw[12] ^= 0x01  # first payload byte (4 magic + 8 header)
+    path.write_bytes(bytes(raw))
+    with open(path, "rb") as f:
+        with pytest.raises(SpoolCorruptionError) as ei:
+            list(iter_frames(f, str(path)))
+    assert "CRC32" in str(ei.value)
+    assert ei.value.path == str(path)
+    # EXTERNAL error code → the FTE loop treats it as retryable
+    assert ei.value.is_retryable()
+
+
+def test_v2_torn_write_detected(tmp_path):
+    path = tmp_path / "torn.bin"
+    with open(path, "wb") as f:
+        write_stream_header(f)
+        write_frame_crc(f, b"will be cut short")
+    path.write_bytes(path.read_bytes()[:-5])
+    with open(path, "rb") as f:
+        with pytest.raises(SpoolCorruptionError):
+            list(iter_frames(f, str(path)))
+    # a frame header cut mid-word is also corruption, not EOF
+    hdr_only = tmp_path / "hdr.bin"
+    hdr_only.write_bytes(SPOOL_STREAM_MAGIC + struct.pack("<I", 9))
+    with open(hdr_only, "rb") as f:
+        with pytest.raises(SpoolCorruptionError):
+            list(iter_frames(f, str(hdr_only)))
+
+
+def test_durable_spool_writes_v2(tmp_path):
+    """DurableSpoolWriter streams carry the CRC header so every FTE spool
+    read is integrity-checked end to end."""
+    from trino_tpu.execution.durable_spool import DurableSpoolWriter
+
+    w = DurableSpoolWriter(str(tmp_path / "f0_t0"), attempt=0,
+                           num_partitions=1)
+    w.set_finished()
+    part0 = os.path.join(w.committed, "part-0.bin")
+    with open(part0, "rb") as f:
+        assert f.read(4) == SPOOL_STREAM_MAGIC
+
+
+# ------------------------------------------------------------ lease/GC
+def _mkroot(base, name, nbytes=64, lease=None, mtime=None):
+    root = base / name
+    root.mkdir()
+    (root / "part-0.bin").write_bytes(b"\0" * nbytes)
+    if lease is not None:
+        spool_gc.acquire(str(root), **lease)
+    if mtime is not None:
+        os.utime(root, (mtime, mtime))
+    return str(root)
+
+
+def test_release_reclaims_now(tmp_path):
+    root = _mkroot(tmp_path, "trino-tpu-spool-a",
+                   lease={"query_id": "q1"})
+    assert spool_gc.release(root) > 0
+    assert not os.path.exists(root)
+    assert spool_gc.release(root) == 0  # idempotent
+
+
+def test_sweep_rules(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_SPOOL_DIR", str(tmp_path))
+    monkeypatch.setenv("TRINO_TPU_SPOOL_TTL_S", "3600")
+    import time
+    now = time.time()
+
+    pinned = _mkroot(tmp_path, "trino-tpu-spool-pinned",
+                     lease={"query_id": "qp", "ttl_s": 1.0})
+    live = _mkroot(tmp_path, "trino-tpu-spool-live",
+                   lease={"query_id": "ql"})  # our own live pid
+    dead = _mkroot(tmp_path, "trino-tpu-spool-dead")
+    # forge a dead-owner lease (pid from a long-gone process)
+    spool_gc.acquire(dead, "qd")
+    import json
+    lp = os.path.join(dead, spool_gc.LEASE_FILE)
+    rec = json.load(open(lp))
+    rec["pid"] = 2 ** 22 + 12345
+    json.dump(rec, open(lp, "w"))
+    expired = _mkroot(tmp_path, "trino-tpu-spool-expired",
+                      lease={"query_id": "qe", "ttl_s": 0.001})
+    stale = _mkroot(tmp_path, "trino-tpu-spool-stale",
+                    mtime=now - 7200)  # no lease, past TTL
+    fresh = _mkroot(tmp_path, "trino-tpu-spool-fresh", mtime=now - 10)
+    other = tmp_path / "unrelated-dir"
+    other.mkdir()
+
+    out = spool_gc.sweep(keep=[pinned], now=now + 5.0)
+    assert pinned in out["kept"]        # keep= pins even an expired lease
+    assert live in out["kept"]          # live pid + unexpired ttl
+    assert fresh in out["kept"]         # no lease but young
+    assert dead in out["reclaimed"] and not os.path.exists(dead)
+    assert expired in out["reclaimed"] and not os.path.exists(expired)
+    assert stale in out["reclaimed"] and not os.path.exists(stale)
+    assert other.exists()               # non-spool names untouched
+    assert out["live_bytes"] > 0
+
+
+def test_sweep_byte_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_SPOOL_DIR", str(tmp_path))
+    monkeypatch.setenv("TRINO_TPU_SPOOL_TTL_S", "86400")
+    monkeypatch.setenv("TRINO_TPU_SPOOL_MAX_BYTES", "1500")
+    import time
+    now = time.time()
+    old = _mkroot(tmp_path, "trino-tpu-spool-old", nbytes=1000,
+                  mtime=now - 500)
+    new = _mkroot(tmp_path, "trino-tpu-spool-new", nbytes=1000,
+                  mtime=now - 100)
+    out = spool_gc.sweep(now=now)
+    # over budget: the OLDEST unpinned root goes first, the newer survives
+    assert old in out["reclaimed"] and not os.path.exists(old)
+    assert new in out["kept"] and os.path.exists(new)
+    assert out["live_bytes"] == 1000

@@ -36,11 +36,11 @@ LEGACY_PRAGMA = "metric-ok"
 KINDS = ("counter", "gauge", "distribution")
 
 # metric families the observability plane is contractually expected to
-# expose (PR 11 flight recorder, PR 12 cache plane, PR 13 adaptive): at
-# least one registration of each must exist, so a refactor can't silently
-# drop that telemetry
+# expose (PR 11 flight recorder, PR 12 cache plane, PR 13 adaptive, PR 15
+# fault-tolerant execution): at least one registration of each must exist,
+# so a refactor can't silently drop that telemetry
 REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_",
-                     "trino_adaptive_")
+                     "trino_adaptive_", "trino_fte_")
 
 
 def _registrations(tree: ast.Module, lines: list) -> list:

@@ -456,6 +456,8 @@ class DistributedQueryRunner:
                 SPECULATIVE,
                 STANDARD,
                 StreamingSpeculation,
+                StreamingSpoolTee,
+                nonleaf_speculation_enabled,
                 speculation_enabled,
             )
 
@@ -497,6 +499,44 @@ class DistributedQueryRunner:
                     for t in range(stages[f.id].task_count):
                         spec_gates[(f.id, t)] = spec.register_task(f.id, t)
 
+            tee: Optional[StreamingSpoolTee] = None
+            if (spec is not None and adaptive is None
+                    and nonleaf_speculation_enabled(self.session)):
+                # non-leaf twin eligibility (r15): a stage whose sources
+                # all land in plain OutputBuffers can speculate too — its
+                # producers tee winner pages into a durable per-task spool
+                # (SpoolTeeBuffer), and the twin re-reads committed tee
+                # dirs once EVERY source task has committed.  Collective/
+                # fused edges and adaptive routing bypass stage.buffers,
+                # so those fragments stay leaf-only.
+                nonleaf = [
+                    f for f in fragments
+                    if f.source_fragments and f.id not in edges
+                    and stages[f.id].task_count >= 2
+                    and not _writes(f.root)
+                    and all(src not in edges for src in f.source_fragments)
+                ]
+                if nonleaf:
+                    from .durable_spool import make_spool_root
+
+                    from . import spool_gc
+
+                    tee = StreamingSpoolTee(make_spool_root(
+                        getattr(self.session, "fte_spool_dir", None)))
+                    spool_gc.acquire(
+                        tee.root, qrec.query_id if qrec is not None
+                        else "adhoc")
+                    for f in nonleaf:
+                        srcs = tuple(f.source_fragments)
+                        spec.register_stage(
+                            f.id, stages[f.id].task_count,
+                            eligible=lambda _s=srcs: tee.ready(_s))
+                        for t in range(stages[f.id].task_count):
+                            spec_gates[(f.id, t)] = \
+                                spec.register_task(f.id, t)
+                        for src in srcs:
+                            tee.want(src, stages[src].task_count)
+
             def _spawn_stage(fid: int) -> list[threading.Thread]:
                 stage = stages[fid]
                 out = []
@@ -510,7 +550,7 @@ class DistributedQueryRunner:
                         target=self._run_task,
                         args=(stage, t, stages, errors, stats_sink,
                               edges, attempt, parent_span, qrec, mem_qid,
-                              ctx, adaptive),
+                              ctx, adaptive, tee),
                         name=f"task-{fid}.{t}",
                         daemon=True,
                     )
@@ -539,7 +579,7 @@ class DistributedQueryRunner:
                     target=self._run_task,
                     args=(stages[fid], t, stages, errors, stats_sink,
                           edges, attempt + 1000, parent_span, qrec,
-                          mem_qid, twin_ctx, adaptive),
+                          mem_qid, twin_ctx, adaptive, tee),
                     name=f"task-{fid}.{t}-speculative",
                     daemon=True,
                 )
@@ -582,6 +622,13 @@ class DistributedQueryRunner:
             hung = [th.name for th in pending if th.is_alive()]
             if adaptive is not None and not errors:
                 hung += adaptive.unactivated()
+            if tee is not None:
+                # all tasks (and any twins) are done or hung: the tee spool
+                # served its purpose.  A coordinator killed before this
+                # line leaks the root to the boot-time spool_gc sweep.
+                from . import spool_gc
+
+                spool_gc.release(tee.root)
             if spec is not None:
                 self.speculative_starts += spec.starts
                 self.speculative_wins += spec.wins
@@ -693,6 +740,7 @@ class DistributedQueryRunner:
         def on_read(_d, _fid=fragment.id, _t=task_index, _a=attempt):
             if injector is not None:
                 injector.maybe_fail(GET_RESULTS_FAILURE, _fid, _t, _a)
+                injector.maybe_corrupt_spool(_d, _fid, _t, _a)
 
         clients = {}
         for src, info in upstream.items():
@@ -738,6 +786,45 @@ class DistributedQueryRunner:
         if stats is not None:
             stats_sink.append(stats)
         return writer.committed
+
+    # -------------------------------------------------------------- recovery
+    def pending_fte_recoveries(self) -> list:
+        """In-flight ``retry_policy="TASK"`` queries a dead coordinator
+        left in the query-state WAL (execution/query_state.py) — the boot
+        recovery work list the protocol dispatcher drains."""
+        from . import query_state
+
+        if not query_state.enabled():
+            return []
+        return query_state.pending()
+
+    def resume_fte_query(self, pq) -> QueryResult:
+        """Rehydrate one recovered query: decode the WAL's plan snapshot
+        and re-enter the FTE loop with its committed-attempt map seeded —
+        committed attempts are never re-executed (run_fte_query skips
+        them; the WAL's attempt counters make that assertable).  Runs
+        under the ORIGINAL query id so a reattaching client's
+        ``GET /v1/statement/{id}`` polling resolves."""
+        from ..runner import run_with_query_events
+        from ..telemetry import metrics as tm
+        from ..telemetry import profiler
+        from . import query_state
+        from .fte import run_fte_query
+
+        subplan = query_state.decode_plan(pq.plan_b64)
+        tm.FTE_QUERY_RECOVERIES.inc()
+        profiler.instant(profiler.RECOVERY, "query-resume",
+                         query_id=pq.query_id,
+                         committed=len(pq.committed),
+                         fingerprint=pq.fingerprint)
+
+        def thunk():
+            return self._to_result(
+                subplan, run_fte_query(self, subplan, None, resume=pq))
+
+        return run_with_query_events(
+            pq.query_id, pq.sql, self.session.user, self.event_listeners,
+            self.tracer, thunk)
 
     # ----------------------------------------------------------------- drain
     def drain_worker(self, node_id: str) -> dict:
@@ -811,8 +898,9 @@ class DistributedQueryRunner:
                     memory_owner: Optional[str] = None,
                     spec_ctx: Optional[dict] = None,
                     adaptive=None,
+                    tee=None,
                     ) -> tuple[list, Optional[QueryStats]]:
-        from .speculation import SpeculationLost
+        from .speculation import SPECULATIVE, SpeculationLost
 
         f = stage.fragment
         # engine-level fault injection on the in-process streaming path,
@@ -836,6 +924,23 @@ class DistributedQueryRunner:
             injector.maybe_fail(TASK_FAILURE, f.id, task_index, attempt)
         clients = {}
         for src in f.source_fragments:
+            if (tee is not None and spec_ctx is not None
+                    and spec_ctx["kind"] == SPECULATIVE):
+                # non-leaf twin: the streaming exchange already freed the
+                # pages its primary consumed — re-read the committed tee
+                # spool instead (eligibility guaranteed every source task
+                # committed before this twin launched)
+                from .durable_spool import DurableSpoolClient
+
+                dirs = tee.committed_dirs(src)
+                if dirs is None:
+                    raise SpeculationLost(spec_ctx["kind"])
+                if stages[src].fragment.output_kind == "MERGE":
+                    clients[src] = [DurableSpoolClient([d], task_index)
+                                    for d in dirs]
+                else:
+                    clients[src] = DurableSpoolClient(dirs, task_index)
+                continue
             routed = (adaptive.routed_buffer(src)
                       if adaptive is not None else None)
             if routed is not None:
@@ -893,6 +998,20 @@ class DistributedQueryRunner:
                 from .speculation import GatedBuffer
 
                 out = GatedBuffer(out, spec_ctx["gate"], spec_ctx["kind"])
+            if tee is not None and tee.wants(f.id):
+                # this fragment feeds a speculation-eligible non-leaf
+                # stage: tee winner pages into the durable spool so a
+                # straggling consumer's twin can re-read them.  Outside
+                # the gate — a losing attempt never reaches the tee.
+                from .speculation import SpoolTeeBuffer
+
+                out = SpoolTeeBuffer(
+                    out,
+                    tee.writer(f.id, task_index,
+                               stage.buffers[task_index].num_partitions,
+                               attempt=attempt),
+                    on_commit=lambda d, _f=f.id, _t=task_index:
+                        tee.mark_committed(_f, _t, d))
             kind = f.output_kind if f.output_kind != "OUTPUT" else "GATHER"
             sketch, sketch_keys = None, ()
             if adaptive is not None:
@@ -1004,7 +1123,7 @@ class DistributedQueryRunner:
                   attempt: int = 0, parent_span=None,
                   query_record=None, memory_owner=None,
                   spec_ctx: Optional[dict] = None,
-                  adaptive=None) -> None:
+                  adaptive=None, tee=None) -> None:
         import time as _time
 
         from ..exec.driver import collect_scan_stats
@@ -1037,7 +1156,7 @@ class DistributedQueryRunner:
                 pipelines, stats = self._build_task(
                     stage, task_index, stages, stats_sink, collective or {},
                     attempt, memory_owner=memory_owner, spec_ctx=spec_ctx,
-                    adaptive=adaptive)
+                    adaptive=adaptive, tee=tee)
                 run_pipelines(pipelines, stats)
             except SpeculationLost:
                 # this attempt lost the first-commit race — its twin owns

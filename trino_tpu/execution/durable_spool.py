@@ -13,23 +13,38 @@ java:40, FileSystemExchangeSink):
   process is invisible (the reference's exactly-once sink contract);
 - readers stream frames from the committed directory; a worker-process
   death after commit loses nothing because the pages live on shared disk.
+
+Part files carry the serde v2 CRC-checked stream framing (TTS2 header +
+per-frame CRC32) so post-commit corruption — a bit flip or a torn sector —
+surfaces as a retryable :class:`~.serde.SpoolCorruptionError` instead of
+silently deserializing garbage; pre-CRC part files remain readable.
 """
 
 from __future__ import annotations
 
 import os
 import shutil
-import struct
 import tempfile
 from typing import Iterator, Optional
 
 from ..spi.batch import ColumnBatch
-from .serde import deserialize_batch, iter_frames, serialize_batch
+from .serde import (deserialize_batch, iter_frames, serialize_batch,
+                    write_frame_crc, write_stream_header)
 
 __all__ = ["DurableSpoolWriter", "DurableSpoolClient", "make_spool_root"]
 
 
 def make_spool_root(base: Optional[str] = None) -> str:
+    """New per-query spool root under ``base``, the TRINO_TPU_SPOOL_DIR
+    knob, or the system tempdir (first one set wins).  Callers register
+    the root with :mod:`.spool_gc` so retention and the boot-time leak
+    sweep know about it."""
+    if base is None:
+        from ..spi.knobs import get_str
+
+        base = get_str("TRINO_TPU_SPOOL_DIR") or None
+        if base:
+            os.makedirs(base, exist_ok=True)
     return tempfile.mkdtemp(prefix="trino-tpu-spool-", dir=base)
 
 
@@ -48,13 +63,13 @@ class DurableSpoolWriter:
             open(os.path.join(self._tmp, f"part-{p}.bin"), "wb")
             for p in range(num_partitions)
         ]
+        for f in self._files:
+            write_stream_header(f)
         self.committed: Optional[str] = None
 
     def enqueue(self, partition: int, page) -> None:
         raw = page.data if hasattr(page, "data") else serialize_batch(page)
-        f = self._files[partition]
-        f.write(struct.pack("<I", len(raw)))
-        f.write(raw)
+        write_frame_crc(self._files[partition], raw)
 
     def set_finished(self) -> None:
         if self.committed is not None:  # idempotent (sink + runner both call)
@@ -86,7 +101,7 @@ def _iter_partition(attempt_dir: str, partition: int) -> Iterator[ColumnBatch]:
     if not os.path.exists(path):
         return
     with open(path, "rb") as f:
-        for frame in iter_frames(f):
+        for frame in iter_frames(f, path):
             yield deserialize_batch(frame)
 
 

@@ -16,13 +16,14 @@ FTE can deterministically lose a worker mid-stage.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 __all__ = ["FailureInjector", "InjectedFailure",
            "TASK_FAILURE", "GET_RESULTS_FAILURE", "PROCESS_EXIT",
-           "TASK_STALL", "TASK_OOM",
+           "TASK_STALL", "TASK_OOM", "SPOOL_CORRUPTION",
            "match_wire_rule", "check_wire_rules", "sleep_with_cancel"]
 
 TASK_FAILURE = "TASK_FAILURE"
@@ -33,6 +34,11 @@ PROCESS_EXIT = "PROCESS_EXIT"
 # injected OOM — ExponentialGrowthPartitionMemoryEstimator.java:55):
 TASK_STALL = "TASK_STALL"  # sleep stall_s inside the task body
 TASK_OOM = "TASK_OOM"  # raise ExceededMemoryLimitError inside the task body
+# r15: flip a byte inside a committed spool part file right before a
+# consumer reads it — the on-disk bit-rot / torn-sector case the CRC frame
+# checksums exist to catch (the read then raises SpoolCorruptionError and
+# the FTE loop re-executes the corrupted producer attempt)
+SPOOL_CORRUPTION = "SPOOL_CORRUPTION"
 
 
 class InjectedFailure(RuntimeError):
@@ -121,6 +127,25 @@ class FailureInjector:
                         f"injected {kind} at f{fragment_id}.t{task_index} "
                         f"attempt {attempt}")
 
+    def maybe_corrupt_spool(self, attempt_dir: str, fragment_id: int,
+                            task_index: int, attempt: int = 0) -> None:
+        """When a SPOOL_CORRUPTION rule matches the READING task's
+        coordinates, flip one payload byte of the part file that task is
+        about to consume from ``attempt_dir`` (deterministic offset: the
+        first byte after the stream header + frame header).  The torn/
+        flipped frame then fails its CRC at read time."""
+        matched = False
+        with self._lock:
+            for r in self.rules:
+                if r.matches(SPOOL_CORRUPTION, fragment_id, task_index,
+                             attempt):
+                    r.fired += 1
+                    matched = True
+                    break
+        if not matched:
+            return
+        corrupt_spool_file(attempt_dir, task_index)
+
     def maybe_stall(self, fragment_id: int, task_index: int,
                     attempt: int = 0, should_cancel=None) -> None:
         """Sleep (outside the lock) when a TASK_STALL rule matches — the
@@ -137,6 +162,36 @@ class FailureInjector:
                     delay = max(delay, r.stall_s)
         if delay:
             sleep_with_cancel(delay, should_cancel)
+
+
+def corrupt_spool_file(attempt_dir: str, partition: int) -> bool:
+    """XOR one payload byte of ``part-<partition>.bin`` under
+    ``attempt_dir`` (falling back to any part file large enough).  Returns
+    True if a byte was flipped.  Shared by the injector and the chaos
+    harness's standalone torn-write drills."""
+    candidates = [os.path.join(attempt_dir, f"part-{partition}.bin")]
+    try:
+        candidates += sorted(
+            os.path.join(attempt_dir, n) for n in os.listdir(attempt_dir)
+            if n.startswith("part-") and n.endswith(".bin"))
+    except OSError:
+        return False
+    # byte 12 = stream magic (4) + frame length (4) + frame crc (4): the
+    # first payload byte, so the flip damages data, not framing
+    offset = 12
+    for path in candidates:
+        try:
+            if os.path.getsize(path) <= offset:
+                continue
+            with open(path, "r+b") as f:
+                f.seek(offset)
+                b = f.read(1)
+                f.seek(offset)
+                f.write(bytes([b[0] ^ 0xFF]))
+            return True
+        except OSError:
+            continue
+    return False
 
 
 def sleep_with_cancel(delay: float, should_cancel=None,

@@ -20,10 +20,33 @@ from typing import Optional
 import numpy as np
 
 from ..spi.batch import Column, ColumnBatch
+from ..spi.errors import PAGE_TRANSPORT_ERROR, TrinoError
 from ..spi.types import Type, parse_type
 
 __all__ = ["serialize_batch", "deserialize_batch", "write_frame",
-           "iter_frames", "CODEC_NONE", "CODEC_ZLIB"]
+           "iter_frames", "CODEC_NONE", "CODEC_ZLIB",
+           "SPOOL_STREAM_MAGIC", "SpoolCorruptionError",
+           "write_stream_header", "write_frame_crc"]
+
+# v2 spool-stream header: a file starting with these 4 bytes carries
+# CRC-checked frames ([u32 len][u32 crc32][payload]); any other first word
+# is a v1 length prefix ([u32 len][payload]) — as a length it would mean an
+# ~844 MB frame, far past any page the engine writes, so the two formats
+# cannot collide and old spool/spill/connector files stay readable.
+SPOOL_STREAM_MAGIC = b"TTS2"
+
+
+class SpoolCorruptionError(TrinoError):
+    """A spool frame failed its CRC32 (bit flip) or ended mid-frame (torn
+    write that slipped past the atomic-rename commit, e.g. disk-level
+    corruption after commit).  EXTERNAL/retryable: the FTE loop discards
+    the corrupt attempt and re-executes its producer instead of
+    deserializing garbage."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(PAGE_TRANSPORT_ERROR,
+                         f"spool corruption in {path}: {detail}")
+        self.path = path
 
 
 def write_frame(f, page: bytes) -> None:
@@ -34,15 +57,50 @@ def write_frame(f, page: bytes) -> None:
     f.write(page)
 
 
-def iter_frames(f):
-    """Yield every frame's bytes from a seekable file opened at a frame
-    boundary."""
+def write_stream_header(f) -> None:
+    """Start a v2 CRC-checked frame stream (call once, before any
+    write_frame_crc on the same file)."""
+    f.write(SPOOL_STREAM_MAGIC)
+
+
+def write_frame_crc(f, page: bytes) -> None:
+    """Append one v2 frame: [u32 LE length][u32 LE crc32][bytes]."""
+    f.write(struct.pack("<II", len(page), zlib.crc32(page) & 0xFFFFFFFF))
+    f.write(page)
+
+
+def _iter_frames_crc(f, path: str):
     while True:
-        hdr = f.read(4)
-        if len(hdr) < 4:
+        hdr = f.read(8)
+        if not hdr:
             return
-        (n,) = struct.unpack("<I", hdr)
+        if len(hdr) < 8:
+            raise SpoolCorruptionError(path, "truncated frame header")
+        n, crc = struct.unpack("<II", hdr)
+        payload = f.read(n)
+        if len(payload) < n:
+            raise SpoolCorruptionError(
+                path, f"torn frame: expected {n} bytes, got {len(payload)}")
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise SpoolCorruptionError(path, "frame CRC32 mismatch")
+        yield payload
+
+
+def iter_frames(f, path: str = "<stream>"):
+    """Yield every frame's bytes from a file opened at the stream start.
+    Auto-detects the format: a SPOOL_STREAM_MAGIC header selects v2
+    CRC-checked frames (raising :class:`SpoolCorruptionError` on mismatch
+    or truncation); anything else is the original unchecked v1 framing."""
+    first = f.read(4)
+    if first == SPOOL_STREAM_MAGIC:
+        yield from _iter_frames_crc(f, path)
+        return
+    while True:
+        if len(first) < 4:
+            return
+        (n,) = struct.unpack("<I", first)
         yield f.read(n)
+        first = f.read(4)
 
 _MAGIC = b"TTP1"
 CODEC_NONE = 0

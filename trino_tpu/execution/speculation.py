@@ -13,11 +13,19 @@ first write raises :class:`SpeculationLost` and its attempt unwinds quietly
 downstream).
 
 Scope: tasks whose fragment has no remote sources (leaf stages) and whose
-sink is a plain OutputBuffer.  A non-leaf streaming twin would have to
-re-read its producers' page streams, but the streaming exchange frees pages
-on ack (execution/exchange.py) — there is nothing durable to re-read.  That
-retention is exactly what FTE's spool buys, so non-leaf speculation stays an
-FTE (retry_policy=TASK) capability; MapReduce draws the same line (maps
+sink is a plain OutputBuffer re-execute for free — a leaf twin re-reads its
+splits from the connector.  A non-leaf streaming twin has to re-read its
+producers' page streams, but the streaming exchange frees pages on ack
+(execution/exchange.py) — there is nothing durable to re-read.  That
+retention is exactly what FTE's spool buys, and since r15 the streaming
+path can buy it too: with ``TRINO_TPU_SPECULATION_NONLEAF`` on, producers
+feeding an eligible non-leaf stage tee their (winner-only) pages through
+:class:`SpoolTeeBuffer` into a :class:`StreamingSpoolTee` — per-task
+durable spool dirs committed by atomic rename, exactly the FTE sink
+contract.  Once EVERY source task of a non-leaf stage has committed its
+tee, the stage becomes twin-eligible; a straggler's SPECULATIVE attempt
+re-reads the committed tee dirs through DurableSpoolClient instead of the
+(already-drained) streaming exchange.  MapReduce draws the same line (maps
 re-execute from durable input; reducers re-read retained map output —
 Dean & Ghemawat, OSDI'04).
 
@@ -37,8 +45,9 @@ import time
 from typing import Callable, Optional
 
 __all__ = ["ClusterBlacklist", "SpeculationLost", "TaskGate", "GatedBuffer",
-           "StreamingSpeculation", "speculation_enabled", "drain_timeout_s",
-           "STANDARD", "SPECULATIVE"]
+           "StreamingSpeculation", "StreamingSpoolTee", "SpoolTeeBuffer",
+           "speculation_enabled", "nonleaf_speculation_enabled",
+           "drain_timeout_s", "STANDARD", "SPECULATIVE"]
 
 STANDARD = "STANDARD"
 SPECULATIVE = "SPECULATIVE"
@@ -51,6 +60,18 @@ def speculation_enabled(session) -> bool:
     if v is None:
         return os.environ.get("TRINO_TPU_SPECULATION", "0").strip().lower() \
             in ("1", "true", "on")
+    return bool(v)
+
+
+def nonleaf_speculation_enabled(session) -> bool:
+    """Non-leaf twin eligibility (requires the spool tee): session
+    tri-state, then the TRINO_TPU_SPECULATION_NONLEAF knob; off by
+    default.  Only meaningful when :func:`speculation_enabled` is on."""
+    v = getattr(session, "speculation_nonleaf", None)
+    if v is None:
+        from ..spi.knobs import get_bool
+
+        return get_bool("TRINO_TPU_SPECULATION_NONLEAF")
     return bool(v)
 
 
@@ -142,6 +163,100 @@ class GatedBuffer:
         self._inner.abort()
 
 
+class StreamingSpoolTee:
+    """Per-query durable tee of streaming producer outputs (the retention
+    layer non-leaf speculation needs).  ``want()`` marks a producer
+    fragment as teed; its tasks' sinks wrap in :class:`SpoolTeeBuffer`,
+    which lands every winner page under
+    ``<root>/f<fid>_t<t>/attempt-<n>`` via DurableSpoolWriter (atomic
+    rename on commit — identical on-disk layout to the FTE spool, so
+    DurableSpoolClient reads it unchanged).  ``ready(srcs)`` answers the
+    twin-eligibility question: has every task of every source fragment
+    committed its tee?  Callers lease ``root`` through
+    :mod:`.spool_gc` (release at query end; boot sweep catches leaks)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._lock = threading.Lock()
+        self._want: dict[int, int] = {}            # fid -> task count
+        self._committed: dict[int, dict[int, str]] = {}  # fid -> {t: dir}
+
+    def want(self, fid: int, task_count: int) -> None:
+        with self._lock:
+            self._want[fid] = task_count
+            self._committed.setdefault(fid, {})
+
+    def wants(self, fid: int) -> bool:
+        with self._lock:
+            return fid in self._want
+
+    def writer(self, fid: int, t: int, num_partitions: int,
+               attempt: int = 0):
+        from .durable_spool import DurableSpoolWriter
+        from .fte import fte_task_dir
+
+        task_dir = fte_task_dir(self.root, fid, t)
+        os.makedirs(task_dir, exist_ok=True)
+        return DurableSpoolWriter(task_dir, attempt, num_partitions)
+
+    def mark_committed(self, fid: int, t: int, attempt_dir: str) -> None:
+        with self._lock:
+            self._committed.setdefault(fid, {})[t] = attempt_dir
+
+    def ready(self, fids) -> bool:
+        with self._lock:
+            return all(
+                len(self._committed.get(f, ())) >= self._want.get(f, 1 << 30)
+                for f in fids)
+
+    def committed_dirs(self, fid: int) -> Optional[list]:
+        """Task-ordered committed attempt dirs, or None while incomplete."""
+        with self._lock:
+            got = self._committed.get(fid, {})
+            if len(got) < self._want.get(fid, 1 << 30):
+                return None
+            return [got[t] for t in sorted(got)]
+
+
+class SpoolTeeBuffer:
+    """Sink facade teeing every page that clears ``inner`` (the gated or
+    plain OutputBuffer) into a durable spool writer.  The tee sits OUTSIDE
+    the gate: a losing attempt's enqueue raises SpeculationLost before the
+    tee sees the page, so the committed tee holds exactly the winner's
+    stream."""
+
+    def __init__(self, inner, writer, on_commit: Callable[[str], None]):
+        self._inner = inner
+        self._writer = writer
+        self._on_commit = on_commit
+
+    @property
+    def num_partitions(self) -> int:
+        return self._inner.num_partitions
+
+    @property
+    def aborted(self) -> bool:
+        return self._inner.aborted
+
+    def enqueue(self, partition: int, batch, **kw) -> None:
+        self._inner.enqueue(partition, batch, **kw)
+        self._writer.enqueue(partition, batch)
+
+    def has_capacity(self) -> bool:
+        return self._inner.has_capacity()
+
+    def set_finished(self) -> None:
+        self._inner.set_finished()  # loser raises here; tee stays .tmp
+        self._writer.set_finished()
+        self._on_commit(self._writer.committed)
+
+    def abort(self) -> None:
+        try:
+            self._inner.abort()
+        finally:
+            self._writer.abort()
+
+
 class _TaskTrack:
     __slots__ = ("gate", "twin_started", "cancel", )
 
@@ -157,14 +272,19 @@ class _TaskTrack:
 
 
 class _StageTrack:
-    __slots__ = ("fid", "tc", "t0", "tasks", "durations")
+    __slots__ = ("fid", "tc", "t0", "tasks", "durations", "eligible")
 
-    def __init__(self, fid: int, tc: int, t0: float):
+    def __init__(self, fid: int, tc: int, t0: float, eligible=None):
         self.fid = fid
         self.tc = tc
         self.t0 = t0
         self.tasks: dict[int, _TaskTrack] = {}
         self.durations: list[float] = []
+        # optional gate on twin launches: non-leaf stages pass a predicate
+        # ("are all my sources' tee spools committed?") that must hold
+        # before any twin spawns — a twin with an incomplete upstream tee
+        # would re-read a truncated stream
+        self.eligible = eligible
 
 
 class StreamingSpeculation:
@@ -187,9 +307,10 @@ class StreamingSpeculation:
         self.wins = 0
 
     # --------------------------------------------------------- registration
-    def register_stage(self, fid: int, tc: int) -> None:
+    def register_stage(self, fid: int, tc: int, eligible=None) -> None:
         with self._lock:
-            self._stages[fid] = _StageTrack(fid, tc, self._clock())
+            self._stages[fid] = _StageTrack(fid, tc, self._clock(),
+                                            eligible=eligible)
 
     def register_task(self, fid: int, t: int) -> TaskGate:
         """Create the task's gate; returns it for sink wrapping."""
@@ -246,6 +367,8 @@ class StreamingSpeculation:
         with self._lock:
             stages = list(self._stages.values())
         for st in stages:
+            if st.eligible is not None and not st.eligible():
+                continue
             with self._lock:
                 committed = len(st.durations)
                 if st.tc < 2 or committed * 2 < st.tc:

@@ -106,6 +106,7 @@ SETTABLE_SESSION_PROPERTIES = {
     "max_worker_replacements", "exchange_backoff_min_s",
     "exchange_backoff_max_s", "exchange_max_failure_duration_s",
     "speculation", "speculation_lag_multiplier", "speculation_min_delay_s",
+    "speculation_nonleaf",
     "blacklist_ttl_s", "blacklist_threshold", "drain_timeout_s",
     "adaptive", "broadcast_threshold_bytes", "skew_factor",
 }
@@ -511,6 +512,12 @@ class Session:
     speculation: object = None
     speculation_lag_multiplier: float = 2.0
     speculation_min_delay_s: float = 0.25
+    # non-leaf streaming speculation (tri-state None defers to
+    # TRINO_TPU_SPECULATION_NONLEAF): producers feeding an eligible
+    # non-leaf stage tee their pages into a durable spool so a straggling
+    # consumer's twin can re-read committed upstream output — the retention
+    # FTE's spool provides, now available to retry_policy=QUERY
+    speculation_nonleaf: object = None
     # cross-query cluster blacklist (coordinator-held, TTL decay): None
     # defers to TRINO_TPU_BLACKLIST_TTL_S / TRINO_TPU_BLACKLIST_THRESHOLD
     blacklist_ttl_s: object = None

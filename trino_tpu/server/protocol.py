@@ -49,17 +49,55 @@ class _Query:
         self.rows: list = []
         self.done = threading.Event()
         self.cancelled = False
+        self.recovered = False  # rehydrated from the query-state WAL
 
 
 class QueryDispatcher:
     """Admission + execution: a bounded pool of query slots (the stand-in
-    for DispatchManager + resource groups)."""
+    for DispatchManager + resource groups).  At boot it also runs
+    coordinator crash recovery: in-flight ``retry_policy="TASK"`` queries
+    found in the query-state WAL are re-registered under their ORIGINAL
+    query ids (so clients reattach through the unchanged
+    ``GET /v1/statement/{id}/{token}`` surface) and resumed from their
+    committed-attempt maps; afterwards the leaked-spool sweep reclaims
+    every spool root no live query owns."""
 
-    def __init__(self, runner, max_concurrent: int = 4):
+    def __init__(self, runner, max_concurrent: int = 4,
+                 recover: bool = True):
         self.runner = runner
         self.pool = ThreadPoolExecutor(max_workers=max_concurrent)
         self.queries: dict[str, _Query] = {}
         self._lock = threading.Lock()
+        self.recovered_query_ids: list[str] = []
+        if recover:
+            self._recover_and_sweep()
+
+    def _recover_and_sweep(self) -> None:
+        from ..execution import query_state, spool_gc
+
+        pending = []
+        try:
+            if hasattr(self.runner, "pending_fte_recoveries"):
+                pending = self.runner.pending_fte_recoveries()
+        except Exception:
+            pending = []
+        keep = []
+        for pq in pending:
+            q = _Query(pq.query_id, pq.sql)
+            q.recovered = True
+            with self._lock:
+                self.queries[q.id] = q
+            if pq.spool_root:
+                keep.append(pq.spool_root)
+            self.recovered_query_ids.append(pq.query_id)
+            self.pool.submit(self._resume, q, pq)
+        try:
+            query_state.prune_ended()
+            # roots under recovery are pinned; everything else follows
+            # lease/TTL/budget rules
+            spool_gc.sweep(keep=keep)
+        except Exception:
+            pass
 
     MAX_RETAINED = 256
 
@@ -99,22 +137,40 @@ class QueryDispatcher:
             # the protocol query id IS the engine query id, so the flight
             # recorder's /v1/query/{id}/profile resolves without a mapping
             result = self.runner.execute(q.sql, query_id=q.id)
-            if q.cancelled:
-                # the engine ran to completion (no mid-kernel interruption
-                # yet), but a cancelled query must not deliver results
-                q.state = "CANCELED"
-                q.done.set()
-                return
-            q.columns = [
-                {"name": n, "type": str(t)}
-                for n, t in zip(result.names, result.batch.types)
-            ]
-            q.rows = [[_json_value(v) for v in row] for row in result.rows()]
-            q.state = "FINISHED"
+            self._deliver(q, result)
         except Exception as e:  # surfaced through the protocol, not the log
             q.error = f"{type(e).__name__}: {e}"
             q.state = "FAILED"
         q.done.set()
+
+    def _resume(self, q: _Query, pq) -> None:
+        """Run one crash-recovered query to completion under its original
+        id; a client that survived the coordinator restart keeps polling
+        the same nextUri and sees the query finish."""
+        if q.cancelled:
+            q.state = "CANCELED"
+            q.done.set()
+            return
+        q.state = "RUNNING"
+        try:
+            self._deliver(q, self.runner.resume_fte_query(pq))
+        except Exception as e:
+            q.error = f"{type(e).__name__}: {e}"
+            q.state = "FAILED"
+        q.done.set()
+
+    def _deliver(self, q: _Query, result) -> None:
+        if q.cancelled:
+            # the engine ran to completion (no mid-kernel interruption
+            # yet), but a cancelled query must not deliver results
+            q.state = "CANCELED"
+            return
+        q.columns = [
+            {"name": n, "type": str(t)}
+            for n, t in zip(result.names, result.batch.types)
+        ]
+        q.rows = [[_json_value(v) for v in row] for row in result.rows()]
+        q.state = "FINISHED"
 
     def _await_memory(self, q: _Query) -> None:
         """Memory-aware admission: estimate the query's peak from the

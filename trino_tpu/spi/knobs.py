@@ -153,6 +153,12 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_QUERY_MAX_MEMORY", "int", "0",
          "Per-query reserved-memory ceiling; exceeding it fails the query "
          "EXCEEDED_MEMORY_LIMIT.  0 = unlimited."),
+    Knob("TRINO_TPU_QUERY_STATE", "bool", "1",
+         "Write-ahead query-state log for retry_policy=TASK queries "
+         "(coordinator crash recovery); 0 disables logging and recovery."),
+    Knob("TRINO_TPU_QUERY_STATE_DIR", "path", "",
+         "Query-state WAL directory; unset uses a per-uid tempdir next to "
+         "the query journal."),
     Knob("TRINO_TPU_RESOURCE_GROUPS", "json", "",
          "Hierarchical resource-group tree (weights, concurrency and "
          "queue limits, selectors) as JSON; unset uses one flat default "
@@ -171,6 +177,19 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_SPECULATION", "bool", "0",
          "Leaf-stage straggler speculation for retry_policy=QUERY "
          "streaming queries."),
+    Knob("TRINO_TPU_SPECULATION_NONLEAF", "bool", "0",
+         "Extend streaming straggler speculation to non-leaf stages by "
+         "teeing producer pages into the durable spool (requires "
+         "speculation on)."),
+    Knob("TRINO_TPU_SPOOL_DIR", "path", "",
+         "Base directory for durable FTE spool roots; unset uses the "
+         "system tempdir."),
+    Knob("TRINO_TPU_SPOOL_MAX_BYTES", "int", "1073741824",
+         "Spool retention byte budget: the GC reclaims expired/leaked "
+         "roots oldest-first once retained spools exceed this."),
+    Knob("TRINO_TPU_SPOOL_TTL_S", "float", "3600",
+         "Retention TTL for unreleased spool roots (crashed or abandoned "
+         "queries); the boot sweep reclaims roots idle past this."),
     Knob("TRINO_TPU_STAGE_DEVICE", "bool", "1",
          "Double-buffered device staging of coalesced scan batches; 0 "
          "leaves batches on host until the operator touches them."),

@@ -402,6 +402,36 @@ BLACKLISTED_WORKERS = REGISTRY.gauge(
     "trino_blacklisted_workers",
     "workers currently blacklisted by the cluster blacklist")
 
+# fault-tolerant execution (execution/fte.py + query_state.py + spool_gc.py)
+FTE_ATTEMPT_STARTS = REGISTRY.counter(
+    "trino_fte_attempt_starts_total", "FTE task attempts started")
+FTE_ATTEMPT_RETRIES = REGISTRY.counter(
+    "trino_fte_attempt_retries_total",
+    "FTE task attempts that were retries of a failed attempt")
+FTE_SPECULATIVE_STARTS = REGISTRY.counter(
+    "trino_fte_speculative_starts_total",
+    "speculative FTE attempt chains launched against stragglers")
+FTE_SPECULATIVE_WINS = REGISTRY.counter(
+    "trino_fte_speculative_wins_total",
+    "speculative FTE attempts that committed first")
+FTE_STAGES_RESUMED = REGISTRY.counter(
+    "trino_fte_stages_resumed_total",
+    "stage tasks skipped on recovery because a prior coordinator "
+    "already committed them")
+FTE_QUERY_RECOVERIES = REGISTRY.counter(
+    "trino_fte_query_recoveries_total",
+    "in-flight FTE queries rehydrated from the query-state WAL after "
+    "a coordinator restart")
+FTE_SPOOL_CORRUPTIONS = REGISTRY.counter(
+    "trino_fte_spool_corruptions_total",
+    "committed spool attempts discarded on CRC mismatch / torn frames")
+FTE_SPOOL_BYTES_LIVE = REGISTRY.gauge(
+    "trino_fte_spool_bytes_live",
+    "bytes currently retained under leased spool roots")
+FTE_SPOOL_BYTES_RECLAIMED = REGISTRY.counter(
+    "trino_fte_spool_bytes_reclaimed_total",
+    "spool bytes reclaimed by release/TTL/budget/boot-sweep GC")
+
 # whole-stage compilation (execution/stage_compiler.py)
 FUSED_STAGES = REGISTRY.counter(
     "trino_fused_stages_total", "fused stage seams executed")

@@ -43,7 +43,7 @@ from typing import Optional
 
 __all__ = [
     "OPERATOR", "FUSED", "EXCHANGE", "STAGE", "SPILL", "SPECULATION",
-    "TASK", "ADAPTIVE",
+    "TASK", "ADAPTIVE", "RECOVERY",
     "level", "enabled", "is_full", "set_level", "event", "instant",
     "now", "set_context", "capture_context", "apply_context", "sync_batch",
     "collect", "harvest", "add_remote_events", "take_task_events",
@@ -59,6 +59,7 @@ SPILL = "spill"
 SPECULATION = "speculation"
 TASK = "task"
 ADAPTIVE = "adaptive"
+RECOVERY = "recovery"
 
 _OFF, _DEFAULT, _FULL = 0, 1, 2
 

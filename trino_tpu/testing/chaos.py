@@ -40,6 +40,7 @@ from ..execution.distributed_runner import DistributedQueryRunner
 from ..execution.failure_injector import (
     GET_RESULTS_FAILURE,
     PROCESS_EXIT,
+    SPOOL_CORRUPTION,
     TASK_FAILURE,
     TASK_OOM,
     TASK_STALL,
@@ -49,7 +50,8 @@ from ..runner import Session
 from .oracle import SqliteOracle, assert_same_rows
 
 __all__ = ["QUERY_MIX", "USER_ERROR_SQL", "build_expected",
-           "run_scenario", "run_chaos"]
+           "run_scenario", "run_chaos", "run_fte_scenario", "run_fte_chaos",
+           "run_coordinator_kill_drill"]
 
 CATALOG_SPEC = {
     "factory": "trino_tpu.connectors.catalog:default_catalog",
@@ -91,6 +93,13 @@ USER_ERROR_SQL = \
 _INPROC_FAULTS = ["none", "none", TASK_FAILURE, TASK_STALL, TASK_OOM,
                   GET_RESULTS_FAILURE, "drain"]
 _PROCESS_FAULTS = _INPROC_FAULTS + [PROCESS_EXIT]
+# FTE (retry_policy=TASK) leg: the streaming menu minus drains (FTE's
+# stage-by-stage loop has no placement to drain in-process) plus
+# SPOOL_CORRUPTION — a byte flipped inside a committed spool part file
+# right before a consumer reads it, which must surface as a CRC-classified
+# SpoolCorruptionError and re-execute only the corrupted producer
+_FTE_FAULTS = ["none", "none", TASK_FAILURE, TASK_STALL, TASK_OOM,
+               GET_RESULTS_FAILURE, SPOOL_CORRUPTION]
 
 
 def build_expected() -> dict:
@@ -262,6 +271,310 @@ def _run_with_drain(runner, sql, mode, rng, timeout_s):
     if th.is_alive():
         return None, None, True, wall
     return holder.get("rows"), holder.get("exc"), False, wall
+
+
+def run_fte_scenario(seed: int, n_queries: int = 6,
+                     expected: Optional[dict] = None,
+                     query_timeout_s: float = 45.0) -> dict:
+    """One seeded FTE chaos scenario: a fresh 2-worker runner under
+    ``retry_policy="TASK"``, each query with a seeded fault from the FTE
+    menu (including SPOOL_CORRUPTION bit flips on committed spool files).
+    The acceptance invariant is the streaming soak's: every query is
+    oracle-correct, classified, or — never — hung."""
+    from ..telemetry import metrics as tm
+
+    if expected is None:
+        expected = build_expected()
+    rng = random.Random(seed)
+    inj = FailureInjector()
+    session = Session(node_count=2, retry_policy="TASK",
+                      failure_injector=inj, task_retry_attempts=4,
+                      fte_speculative=True, fte_speculative_delay_s=0.3)
+    runner = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=session)
+
+    from ..caching import result_cache
+
+    outcomes = []
+    with result_cache.disabled():
+        for qi in range(n_queries):
+            sql = (USER_ERROR_SQL if rng.random() < 0.12
+                   else rng.choice(QUERY_MIX))
+            fault = rng.choice(_FTE_FAULTS)
+            task_index = rng.randrange(2)
+            if fault == TASK_STALL:
+                inj.inject(TASK_STALL, fragment_id=None,
+                           task_index=task_index, attempt=0, times=1,
+                           stall_s=round(0.5 + rng.random() * 0.8, 2))
+            elif fault != "none":
+                inj.inject(fault, fragment_id=None,
+                           task_index=task_index, attempt=0, times=1)
+            retries_before = tm.FTE_ATTEMPT_RETRIES.value()
+            corrupt_before = tm.FTE_SPOOL_CORRUPTIONS.value()
+            rows, exc, hung, wall = _execute_watched(
+                runner, sql, query_timeout_s)
+            retried = tm.FTE_ATTEMPT_RETRIES.value() > retries_before
+            outcome, detail = _classify_outcome(
+                sql, rows, exc, hung, retried, expected)
+            outcomes.append({
+                "query": qi, "sql": sql, "fault": fault,
+                "outcome": outcome, "detail": detail,
+                "wall_s": round(wall, 3), "retried": retried,
+                "spool_corruption_repairs":
+                    tm.FTE_SPOOL_CORRUPTIONS.value() - corrupt_before,
+            })
+            if outcome == "hang":
+                break
+
+    counts: dict = {}
+    for o in outcomes:
+        counts[o["outcome"]] = counts.get(o["outcome"], 0) + 1
+    return {"seed": seed, "mode": "fte", "outcomes": outcomes,
+            "counts": counts}
+
+
+def run_fte_chaos(n_scenarios: int = 12, base_seed: int = 1515,
+                  fte_queries: int = 6, verbose: bool = True) -> dict:
+    """The FTE chaos leg: seeded scenarios over the FTE fault menu.
+    Same acceptance booleans as ``run_chaos`` (PR-9 bar: 100%% of queries
+    accounted, zero hangs)."""
+    expected = build_expected()
+    scenarios = []
+    for i in range(n_scenarios):
+        t0 = time.monotonic()
+        rec = run_fte_scenario(base_seed + i, n_queries=fte_queries,
+                               expected=expected)
+        rec["scenario"] = i
+        rec["wall_s"] = round(time.monotonic() - t0, 2)
+        scenarios.append(rec)
+        if verbose:
+            print(f"  fte chaos scenario {i:2d} seed={base_seed + i} "
+                  f"{rec['counts']} ({rec['wall_s']:.1f}s)", flush=True)
+    totals: dict = {}
+    for rec in scenarios:
+        for k, v in rec["counts"].items():
+            totals[k] = totals.get(k, 0) + v
+    n_queries = sum(len(r["outcomes"]) for r in scenarios)
+    return {
+        "n_scenarios": n_scenarios,
+        "base_seed": base_seed,
+        "n_queries": n_queries,
+        "totals": totals,
+        "hangs": totals.get("hang", 0),
+        "unexpected": totals.get("unexpected", 0),
+        "all_accounted": (totals.get("hang", 0) == 0
+                          and totals.get("unexpected", 0) == 0),
+        "scenarios": scenarios,
+    }
+
+
+# ---------------------------------------------------- coordinator kill -9
+_DRILL_SQL = ("select l_returnflag, l_linestatus, count(*), "
+              "sum(l_quantity) from lineitem group by l_returnflag, "
+              "l_linestatus order by l_returnflag, l_linestatus")
+
+
+def _coordinator_child() -> None:
+    """Subprocess entry for the coordinator-kill drill: boot a 2-worker
+    FTE coordinator behind the HTTP statement protocol, write the bound
+    port to ``CHAOS_PORT_FILE`` (atomic rename), and serve until killed.
+    ``CHAOS_STALL_S`` arms a one-shot TASK_STALL on task 0 of the first
+    stage scheduled — the deterministic 'mid-query' the parent kills
+    into; with ``fte_speculative`` off nothing can rescue the stall, so
+    the kill is guaranteed to land with the query in flight."""
+    import os
+
+    from ..connectors.catalog import default_catalog as _catalog
+    from ..execution.distributed_runner import DistributedQueryRunner as _R
+    from ..execution.failure_injector import FailureInjector as _Inj
+    from ..execution.failure_injector import TASK_STALL as _STALL
+    from ..runner import Session as _S
+    from ..server.protocol import TrinoTpuServer
+
+    inj = None
+    stall_s = float(os.environ.get("CHAOS_STALL_S", "0") or 0)
+    if stall_s > 0:
+        inj = _Inj()
+        inj.inject(_STALL, fragment_id=None, task_index=0, attempt=0,
+                   times=1, stall_s=stall_s)
+    session = _S(node_count=2, retry_policy="TASK", fte_speculative=False,
+                 failure_injector=inj)
+    runner = _R(_catalog(scale_factor=0.01), worker_count=2,
+                session=session)
+    srv = TrinoTpuServer(runner).start()
+    port_file = os.environ["CHAOS_PORT_FILE"]
+    with open(port_file + ".tmp", "w", encoding="utf-8") as f:
+        f.write(str(srv.address[1]))
+    os.replace(port_file + ".tmp", port_file)
+    while True:
+        time.sleep(1.0)
+
+
+def _http_json(method: str, url: str, body: Optional[bytes] = None,
+               timeout: float = 10.0) -> dict:
+    import json
+    from urllib.request import Request, urlopen
+
+    req = Request(url, data=body, method=method)
+    with urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def run_coordinator_kill_drill(stall_s: float = 300.0,
+                               boot_timeout_s: float = 180.0,
+                               finish_timeout_s: float = 180.0,
+                               workdir: Optional[str] = None) -> dict:
+    """The tentpole drill: kill -9 a coordinator mid-FTE-query, restart
+    it, and certify durable recovery end to end.
+
+    Epoch 1 boots a subprocess coordinator with a one-shot un-rescuable
+    stall, submits ``_DRILL_SQL`` over POST /v1/statement, waits (by
+    reading the query-state WAL) until at least one task attempt has
+    committed, then SIGKILLs the process.  Epoch 2 boots a fresh
+    coordinator against the same state/spool dirs; its dispatcher must
+    rehydrate the query under the ORIGINAL id, resume from the committed-
+    attempt map, and finish.  Asserts, from the WAL's attempt counters:
+    committed attempts were NEVER re-executed.  Returns the full record
+    (also the shape tests/test_query_state.py consumes)."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from ..execution import query_state
+
+    work = workdir or tempfile.mkdtemp(prefix="trino-tpu-kill-drill-")
+    state_dir = os.path.join(work, "query-state")
+    spool_dir = os.path.join(work, "spool")
+    port_file = os.path.join(work, "port")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "TRINO_TPU_QUERY_STATE": "1",
+        "TRINO_TPU_QUERY_STATE_DIR": state_dir,
+        "TRINO_TPU_SPOOL_DIR": spool_dir,
+        "TRINO_TPU_RESULT_CACHE": "0",
+        "CHAOS_PORT_FILE": port_file,
+        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    child_cmd = [sys.executable, "-c",
+                 "from trino_tpu.testing.chaos import _coordinator_child; "
+                 "_coordinator_child()"]
+
+    def _boot(extra_env: dict) -> tuple:
+        try:
+            os.remove(port_file)
+        except OSError:
+            pass
+        proc = subprocess.Popen(child_cmd, env={**env, **extra_env},
+                                cwd=repo_root)
+        deadline = time.monotonic() + boot_timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"coordinator child died at boot (rc={proc.returncode})")
+            if os.path.exists(port_file):
+                with open(port_file, encoding="utf-8") as f:
+                    return proc, int(f.read().strip())
+            time.sleep(0.1)
+        proc.kill()
+        raise TimeoutError("coordinator child never wrote its port")
+
+    record: dict = {"sql": _DRILL_SQL, "workdir": work}
+    proc2 = None
+    proc1, port1 = _boot({"CHAOS_STALL_S": str(stall_s)})
+    try:
+        # epoch 1: submit, wait for >=1 committed attempt, kill -9
+        sub = _http_json("POST", f"http://127.0.0.1:{port1}/v1/statement",
+                         _DRILL_SQL.encode("utf-8"))
+        qid = sub["id"]
+        record["query_id"] = qid
+        wal_path = None
+        pq = None
+        deadline = time.monotonic() + boot_timeout_s
+        while time.monotonic() < deadline:
+            walls = [os.path.join(state_dir, n)
+                     for n in os.listdir(state_dir)] \
+                if os.path.isdir(state_dir) else []
+            walls = [w for w in walls if w.endswith(".wal")]
+            if walls:
+                wal_path = walls[0]
+                pq = query_state.load(wal_path)
+                if pq is not None and len(pq.committed) >= 1:
+                    break
+            time.sleep(0.1)
+        if pq is None or not pq.committed:
+            raise TimeoutError("no committed attempt before the kill")
+        committed_at_kill = dict(pq.committed)
+        starts_at_kill = dict(pq.attempt_counts)
+        record["committed_at_kill"] = len(committed_at_kill)
+        os.kill(proc1.pid, signal.SIGKILL)
+        proc1.wait(timeout=30)
+
+        # epoch 2: fresh coordinator, same dirs — recovery must finish the
+        # query under its original id
+        proc2, port2 = _boot({})
+        rows: list = []
+        state = None
+        token = 0
+        deadline = time.monotonic() + finish_timeout_s
+        while time.monotonic() < deadline:
+            out = _http_json(
+                "GET", f"http://127.0.0.1:{port2}/v1/statement/{qid}/{token}")
+            state = out.get("stats", {}).get("state")
+            if state == "FAILED":
+                record["error"] = out.get("error")
+                break
+            rows += out.get("data", [])
+            nxt = out.get("nextUri")
+            if state == "FINISHED":
+                if not nxt:
+                    break
+                token += 1
+                continue
+            time.sleep(0.2)
+        record["state"] = state
+        record["rows"] = rows
+
+        final = query_state.load(wal_path)
+        re_executed = {
+            f"f{fid}_t{t}": final.attempt_counts.get((fid, t), 0)
+            - starts_at_kill.get((fid, t), 0)
+            for (fid, t) in committed_at_kill
+            if final.attempt_counts.get((fid, t), 0)
+            > starts_at_kill.get((fid, t), 0)
+        }
+        record["committed_reexecuted"] = re_executed
+        record["resumed_attempt_starts"] = {
+            f"f{fid}_t{t}": n - starts_at_kill.get((fid, t), 0)
+            for (fid, t), n in final.attempt_counts.items()
+            if n > starts_at_kill.get((fid, t), 0)
+        }
+        record["wal_ended"] = final.ended
+
+        # spool GC: the resumed query's root must be reclaimed at its end
+        spool_root = pq.spool_root
+        deadline = time.monotonic() + 30.0
+        while os.path.isdir(spool_root) and time.monotonic() < deadline:
+            time.sleep(0.2)
+        record["spool_reclaimed"] = not os.path.isdir(spool_root)
+        record["pass"] = (state == "FINISHED" and not re_executed
+                         and record["spool_reclaimed"]
+                         and final.ended == "FINISHED")
+        return record
+    finally:
+        for p in (proc1, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=15)
+        if workdir is None:
+            shutil.rmtree(work, ignore_errors=True)
 
 
 def run_chaos(n_scenarios: int = 25, base_seed: int = 1009,

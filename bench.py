@@ -1434,6 +1434,211 @@ def run_profile_bench() -> None:
     }))
 
 
+# ----------------------------------------------------- compressed execution
+
+ENCODED_QUERIES = {
+    # every group key is a dictionary column: encoded execution group-bys on
+    # int32 codes; the decode-off leg hashes materialized python strings
+    # min/max over the wide-vocabulary comment column run as int32 code
+    # comparisons (the connector's dictionaries are sorted, so code order IS
+    # lexical order); the decode-off leg compares materialized strings
+    "dict_groupby": """
+select l_returnflag, l_linestatus, count(*), sum(l_quantity),
+       min(l_comment), max(l_comment)
+from lineitem group by l_returnflag, l_linestatus""",
+    # low-selectivity filter over wide payload: the mask computes from
+    # l_orderkey alone, payload columns stage LAZY and are dropped unread
+    # for every batch with zero survivors.  The modulo keeps the predicate
+    # out of the scan's advisory TupleDomain (planner/domains.py would push
+    # a plain equality into the connector and prune the scan itself, which
+    # benchmarks pushdown, not late materialization).
+    "lazy_filter": """
+select l_extendedprice, l_discount, l_tax, l_comment
+from lineitem where l_orderkey % 1000000000 = 1""",
+}
+
+
+def run_encoded_leg() -> None:
+    """``--encoded-leg``: one leg of the compressed-execution ladder, run in
+    a fresh interpreter (TRINO_TPU_TPCH_VECTOR_DECODE is read at connector
+    construction, so legs cannot share a process).  Prints one JSON object
+    keyed by query with wall time, rows/s, and staged-bytes accounting from
+    the trino_scan_* / trino_encoding_* registry deltas."""
+    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    # measure execution, not the cache plane: a Tier C hit would serve the
+    # repeat submissions without ever touching the encoded operators
+    os.environ["TRINO_TPU_PLAN_CACHE"] = "0"
+    os.environ["TRINO_TPU_RESULT_CACHE"] = "0"
+    _ensure_backend()
+    _enable_compile_cache()
+
+    import jax
+
+    import trino_tpu.exec.operators as ops
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.runner import Session, StandaloneQueryRunner
+    from trino_tpu.telemetry.metrics import REGISTRY
+
+    # track the peak host-resident batch crossing a bucketing boundary
+    # (LAZY columns count only once materialized — their bytes are exactly
+    # what late materialization keeps off the device)
+    peak = {"v": 0}
+    orig_pad = ops.pad_to_bucket
+
+    def pad_spy(batch):
+        out = orig_pad(batch)
+        resident = sum(c.nbytes for c in out.columns
+                       if c.encoding != "LAZY" or c.is_materialized)
+        peak["v"] = max(peak["v"], resident)
+        return out
+
+    ops.pad_to_bucket = pad_spy
+
+    # many small splits -> many scan batches: late materialization drops
+    # payload at batch granularity, so batch count is the lazy resolution
+    splits = int(os.environ.get("BENCH_ENCODED_SPLITS", "32"))
+    runner = StandaloneQueryRunner(
+        default_catalog(scale_factor=sf),
+        session=Session(splits_per_node=splits))
+
+    def snap() -> dict:
+        s = REGISTRY.snapshot()
+        return {k: s[k]["value"] for k in (
+            "trino_scan_bytes_total",
+            "trino_encoding_bytes_saved_total",
+            "trino_encoding_lazy_skipped_bytes_total",
+            "trino_encoding_lazy_materialized_bytes_total",
+            "trino_encoding_lazy_columns_total",
+            "trino_encoding_lazy_materialized_total",
+            "trino_encoding_rle_agg_rows_total",
+        )}
+
+    out: dict[str, dict] = {}
+    for name, sql in ENCODED_QUERIES.items():
+        input_rows, _ = _scan_stats(runner, sql)
+        runner.execute(sql)  # warmup: compile every jitted program
+        peak["v"] = 0
+        before = snap()
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            r = runner.execute(sql)
+            for c in r.batch.columns:
+                jax.block_until_ready(c.data)
+            samples.append(time.perf_counter() - t0)
+        delta = {k: (v - before[k]) / iters for k, v in snap().items()}
+        samples.sort()
+        wall = samples[len(samples) // 2]
+        scan_b = delta["trino_scan_bytes_total"]
+        # deferred = bytes that never moved: RLE/dict shrinkage plus lazy
+        # deferrals, minus the lazy thunks a surviving row forced to run
+        deferred = (delta["trino_encoding_bytes_saved_total"]
+                    + delta["trino_encoding_lazy_skipped_bytes_total"]
+                    - delta["trino_encoding_lazy_materialized_bytes_total"])
+        out[name] = {
+            "wall_ms": round(wall * 1e3, 1),
+            "input_rows_per_sec": round(input_rows / wall),
+            "scan_bytes": round(scan_b),
+            "staged_bytes": round(scan_b - deferred),
+            "deferred_bytes": round(deferred),
+            # payload view: bytes the filter COULD have skipped (all
+            # lazy-staged columns) vs the part survivor batches forced in
+            "lazy_payload_bytes": round(
+                delta["trino_encoding_lazy_skipped_bytes_total"]),
+            "lazy_payload_staged_bytes": round(
+                delta["trino_encoding_lazy_materialized_bytes_total"]),
+            "peak_batch_bytes": peak["v"],
+            "lazy_columns": delta["trino_encoding_lazy_columns_total"],
+            "lazy_materialized":
+                delta["trino_encoding_lazy_materialized_total"],
+            "rle_agg_rows": delta["trino_encoding_rle_agg_rows_total"],
+        }
+    print(json.dumps(out))
+
+
+def run_encoded_bench() -> None:
+    """``bench.py --encoded``: the compressed-execution ladder (PR 16).
+    Three legs, each a fresh interpreter over the sf-scaled TPC-H connector:
+
+    - **encoded** — TRINO_TPU_ENCODED_EXEC=1: dictionary codes, RLE runs and
+      lazy payload columns flow end-to-end.
+    - **legacy** — TRINO_TPU_ENCODED_EXEC=0: same vectorized connector, but
+      every batch expands at the scan boundary (the bit-for-bit oracle leg).
+    - **legacy_decode_off** — additionally TRINO_TPU_TPCH_VECTOR_DECODE=0:
+      the string-materializing row decoder, i.e. execution with no
+      dictionary anywhere (what a row-oriented engine would stage).
+
+    Acceptance: >=2x rows/s on the dictionary-heavy group-by vs the decoded
+    legacy, and the low-selectivity filter stages <10% of the payload bytes
+    the legacy leg stages (>=5x staged-bytes reduction).  Writes
+    BENCH_r16.json.  Env knobs: BENCH_SF (default 0.2), BENCH_ITERS (3)."""
+    sf = float(os.environ.get("BENCH_SF", "0.2"))
+    legs = {
+        "encoded": {"TRINO_TPU_ENCODED_EXEC": "1"},
+        "legacy": {"TRINO_TPU_ENCODED_EXEC": "0"},
+        "legacy_decode_off": {"TRINO_TPU_ENCODED_EXEC": "0",
+                              "TRINO_TPU_TPCH_VECTOR_DECODE": "0"},
+    }
+    results: dict[str, dict] = {}
+    for leg, env_over in legs.items():
+        env = dict(os.environ, **env_over)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--encoded-leg"],
+            env=env, capture_output=True, text=True, timeout=7200)
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"encoded bench leg {leg!r} failed:\n{proc.stderr[-4000:]}")
+        results[leg] = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(f"leg {leg}: " + ", ".join(
+            f"{q} {r['wall_ms']}ms ({r['input_rows_per_sec']} rows/s, "
+            f"{r['staged_bytes'] / 1e6:.2f} MB staged)"
+            for q, r in results[leg].items()), file=sys.stderr)
+
+    gb_enc = results["encoded"]["dict_groupby"]
+    gb_leg = results["legacy"]["dict_groupby"]
+    gb_str = results["legacy_decode_off"]["dict_groupby"]
+    lf_enc = results["encoded"]["lazy_filter"]
+    lf_leg = results["legacy"]["lazy_filter"]
+    # the legacy leg stages every payload byte; encoded stages only the
+    # columns of batches that had a surviving row
+    payload = max(lf_enc["lazy_payload_bytes"], 1)
+    payload_staged = lf_enc["lazy_payload_staged_bytes"]
+    staged_frac = payload_staged / payload
+    summary = {
+        "dict_groupby_speedup_vs_legacy": round(
+            gb_enc["input_rows_per_sec"] / gb_leg["input_rows_per_sec"], 2),
+        "dict_groupby_speedup_vs_decode_legacy": round(
+            gb_enc["input_rows_per_sec"] / gb_str["input_rows_per_sec"], 2),
+        "lazy_filter_payload_staged_fraction": round(staged_frac, 4),
+        "lazy_filter_staged_bytes_reduction": round(1 / max(
+            staged_frac, 1e-9), 1),
+        "lazy_filter_total_staged_vs_legacy": round(
+            lf_enc["staged_bytes"] / max(lf_leg["staged_bytes"], 1), 4),
+        "lazy_filter_peak_batch_reduction": round(
+            lf_leg["peak_batch_bytes"] / max(lf_enc["peak_batch_bytes"], 1),
+            2),
+    }
+    result = {
+        "metric": f"encoded_exec_sf{sf:g}",
+        "iters": int(os.environ.get("BENCH_ITERS", "3")),
+        "legs": results,
+        "summary": summary,
+        "acceptance": {
+            "dict_groupby_2x": summary[
+                "dict_groupby_speedup_vs_decode_legacy"] >= 2.0,
+            "lazy_filter_staged_under_10pct": staged_frac < 0.10,
+            "lazy_filter_5x_reduction": summary[
+                "lazy_filter_staged_bytes_reduction"] >= 5.0,
+        },
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_r16.json"), "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main() -> None:
     if "--baseline" in sys.argv:
         run_baseline()
@@ -1465,6 +1670,12 @@ def main() -> None:
         return
     if "--adaptive" in sys.argv:
         run_adaptive_bench()
+        return
+    if "--encoded-leg" in sys.argv:
+        run_encoded_leg()
+        return
+    if "--encoded" in sys.argv:
+        run_encoded_bench()
         return
 
     sf = float(os.environ.get("BENCH_SF", "2"))

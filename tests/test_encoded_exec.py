@@ -1,0 +1,335 @@
+"""Compressed execution: encoding-aware operators end-to-end (ISSUE 16).
+
+Covers the tentpole pillars: Column encoding metadata and its propagation
+through batch ops, RLE-aware aggregation (value * run_count, nulls inside
+runs), the serde v2 dictionary sidecar + RLE pages, dictionary codes
+surviving a repartition exchange undecoded, the collective plane keeping
+codes resident, lazy columns that never materialize, and the
+TRINO_TPU_ENCODED_EXEC=0/1 equivalence oracle over the TPC-H suite."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.catalog import default_catalog
+from trino_tpu.connectors.tpch_queries import QUERIES
+from trino_tpu.exec.operators import HashAggregationOperator
+from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+from trino_tpu.execution.serde import (
+    CODEC_NONE,
+    PageStreamEncoder,
+    deserialize_batch,
+    serialize_batch,
+)
+from trino_tpu.planner.plan import AggCall
+from trino_tpu.runner import Session, StandaloneQueryRunner
+from trino_tpu.spi.batch import (
+    Column,
+    ColumnBatch,
+    maybe_rle,
+    pad_to_bucket,
+)
+from trino_tpu.spi.errors import TrinoError
+from trino_tpu.spi.types import BIGINT, DOUBLE, VARCHAR
+from trino_tpu.telemetry.metrics import REGISTRY
+from trino_tpu.testing.oracle import assert_same_rows
+
+
+def _enc(name: str) -> int:
+    return REGISTRY.snapshot()[f"trino_encoding_{name}_total"]["value"]
+
+
+# ----------------------------------------------------- encoding propagation
+
+
+def test_rle_detection_and_propagation():
+    const = Column(BIGINT, np.full(128, 7, np.int64))
+    rle = maybe_rle(const)
+    assert rle.encoding == "RLE" and len(rle) == 128
+    assert rle.nbytes < const.nbytes
+    assert rle.flat_nbytes == const.nbytes
+
+    # varied data must NOT collapse; short runs are not worth probing
+    assert maybe_rle(Column(BIGINT, np.arange(128))).encoding == "FLAT"
+    assert maybe_rle(Column(BIGINT, np.full(8, 7, np.int64))).encoding == "FLAT"
+
+    # slice/take/filter/concat keep the run encoded
+    assert rle.slice_rows(10, 50).encoding == "RLE"
+    assert rle.take(np.array([1, 5, 9])).encoding == "RLE"
+    f = rle.filter(np.arange(128) % 2 == 0)
+    assert f.encoding == "RLE" and len(f) == 64
+    cat = ColumnBatch.concat([
+        ColumnBatch(["x"], [Column.rle(BIGINT, 7, 100)]),
+        ColumnBatch(["x"], [Column.rle(BIGINT, 7, 28)]),
+    ])
+    assert cat.columns[0].encoding == "RLE" and len(cat.columns[0]) == 128
+
+    padded = pad_to_bucket(ColumnBatch(["x"], [Column.rle(BIGINT, 7, 100)]))
+    assert padded.columns[0].encoding == "RLE"
+    assert padded.num_rows >= 100
+    # the expanded view is still correct
+    assert list(np.asarray(rle.data[:3])) == [7, 7, 7]
+
+
+def test_rle_mixed_concat_expands_correctly():
+    cat = ColumnBatch.concat([
+        ColumnBatch(["x"], [Column.rle(BIGINT, 7, 70)]),
+        ColumnBatch(["x"], [Column(BIGINT, np.arange(30, dtype=np.int64))]),
+    ])
+    out = np.asarray(cat.columns[0].data)
+    assert len(out) == 100
+    assert (out[:70] == 7).all() and (out[70:] == np.arange(30)).all()
+
+
+def test_lazy_thunk_runs_once_and_pad_composes():
+    calls = []
+
+    def thunk():
+        calls.append(1)
+        return np.arange(100, dtype=np.int64), None
+
+    lz = Column.lazy(BIGINT, 100, thunk, nbytes_hint=800)
+    assert lz.encoding == "LAZY" and not lz.is_materialized
+    assert lz.nbytes == 800
+    padded = pad_to_bucket(ColumnBatch(["x"], [lz]))
+    pc = padded.columns[0]
+    assert pc.encoding == "LAZY" and not calls, "pad must not materialize"
+    out = np.asarray(pc.data)
+    assert calls == [1] and (out[:100] == np.arange(100)).all()
+    _ = pc.data  # second touch: cached
+    assert calls == [1]
+
+
+def test_lazy_empty_selection_skips_thunk():
+    lz = Column.lazy(BIGINT, 100,
+                     lambda: (np.arange(100, dtype=np.int64), None))
+    empty = lz.filter(np.zeros(100, bool))
+    assert len(empty) == 0 and not lz.is_materialized
+    empty2 = lz.take(np.empty(0, np.int64))
+    assert len(empty2) == 0 and not lz.is_materialized
+
+
+def test_nbytes_includes_dictionary_bytes():
+    d = np.array(["alpha", "beta", "gamma"], dtype=object)
+    plain = Column(BIGINT, np.zeros(8, np.int32))
+    coded = Column(VARCHAR, np.zeros(8, np.int32), None, d)
+    assert coded.nbytes > plain.nbytes, \
+        "dictionary bytes must count toward memory accounting"
+
+
+# -------------------------------------------------------- RLE aggregation
+
+
+def _agg(aggs, names, types, batches):
+    op = HashAggregationOperator([], aggs, names, types)
+    for b in batches:
+        op.add_input(b)
+    op.finish_input()
+    return op, op.get_output()
+
+
+def test_rle_agg_sum_count_min_max_with_nulls_in_runs():
+    # run 1: value 5 x 100, rows 10..19 NULL; run 2: value 3 x 50, all valid
+    v1 = np.ones(100, bool)
+    v1[10:20] = False
+    b1 = ColumnBatch(["x"], [Column.rle(BIGINT, 5, 100, v1)])
+    b2 = ColumnBatch(["x"], [Column.rle(BIGINT, 3, 50)])
+    aggs = [AggCall("sum", 0, BIGINT), AggCall("count", 0, BIGINT),
+            AggCall("min", 0, BIGINT), AggCall("max", 0, BIGINT),
+            AggCall("count_star", -1, BIGINT)]
+    op, out = _agg(aggs, ["s", "c", "lo", "hi", "n"],
+                   [BIGINT] * 5, [b1, b2])
+    assert out.to_pylist() == [(5 * 90 + 3 * 50, 140, 3, 5, 150)]
+    # folded rows are counted per value-aggregate: 4 aggs x 140 live rows
+    assert op.encoding_stats.rle_agg_rows == 4 * 140, \
+        "fast path must fold runs without expanding"
+
+
+def test_rle_agg_all_null_run_is_null():
+    b = ColumnBatch(["x"], [Column.rle(BIGINT, 9, 64, np.zeros(64, bool))])
+    _, out = _agg([AggCall("sum", 0, BIGINT), AggCall("count", 0, BIGINT)],
+                  ["s", "c"], [BIGINT, BIGINT], [b])
+    assert out.to_pylist() == [(None, 0)]
+
+
+def test_rle_agg_respects_live_mask():
+    live = np.zeros(100, bool)
+    live[:30] = True
+    b = ColumnBatch(["x"], [Column.rle(BIGINT, 4, 100)], live)
+    op, out = _agg([AggCall("sum", 0, BIGINT),
+                    AggCall("count_star", -1, BIGINT)],
+                   ["s", "n"], [BIGINT, BIGINT], [b])
+    assert out.to_pylist() == [(4 * 30, 30)]
+    assert op.encoding_stats.rle_agg_rows == 30
+
+
+def test_rle_agg_fast_path_matches_flat():
+    """The fast path and the expanded kernel agree bit-for-bit."""
+    valid = np.ones(200, bool)
+    valid[7::13] = False
+    rle_b = ColumnBatch(["x"], [Column.rle(DOUBLE, 2.5, 200, valid)])
+    flat_b = ColumnBatch(
+        ["x"], [Column(DOUBLE, np.full(200, 2.5), valid.copy())])
+    aggs = [AggCall("sum", 0, DOUBLE), AggCall("count", 0, BIGINT)]
+    _, fast = _agg(aggs, ["s", "c"], [DOUBLE, BIGINT], [rle_b])
+    _, slow = _agg(aggs, ["s", "c"], [DOUBLE, BIGINT], [flat_b])
+    assert fast.to_pylist() == slow.to_pylist()
+
+
+# ------------------------------------------------------------- serde v2
+
+
+def _dict_batch():
+    d = np.array(["a", "b", "c"], dtype=object)
+    return ColumnBatch(
+        ["s", "v"],
+        [Column(VARCHAR, np.array([0, 1, 2, 1, 0], np.int32), None, d),
+         Column(BIGINT, np.arange(5, dtype=np.int64),
+                np.array([1, 1, 0, 1, 1], bool))])
+
+
+def test_serde_v2_dict_sidecar_def_then_ref():
+    b = _dict_batch()
+    ctx = PageStreamEncoder()
+    sent0, reused0 = _enc("dict_sidecar_sent"), _enc("dict_sidecar_reused")
+    p1 = serialize_batch(b, codec=CODEC_NONE, ctx=ctx)  # definition page
+    p2 = serialize_batch(b, codec=CODEC_NONE, ctx=ctx)  # reference page
+    assert p1[:4] == b"TTP2" and len(p2) < len(p1), \
+        "reference pages must not re-ship dictionary values"
+    o1, o2 = deserialize_batch(p1), deserialize_batch(p2)
+    assert o1.to_pylist() == b.to_pylist() == o2.to_pylist()
+    assert list(o2.columns[0].dictionary) == ["a", "b", "c"]
+    assert _enc("dict_sidecar_sent") == sent0 + 1
+    assert _enc("dict_sidecar_reused") == reused0 + 1
+
+
+def test_serde_v2_rle_column_round_trip():
+    b = ColumnBatch(["r", "v"],
+                    [Column.rle(BIGINT, 7, 5),
+                     Column(BIGINT, np.arange(5, dtype=np.int64))])
+    wire = serialize_batch(b, codec=CODEC_NONE, ctx=PageStreamEncoder())
+    # the run crosses the wire as ONE value, and comes back still encoded
+    out = deserialize_batch(wire)
+    assert out.columns[0].encoding == "RLE"
+    assert out.to_pylist() == b.to_pylist()
+
+
+def test_serde_v1_unchanged_without_ctx():
+    b = _dict_batch()
+    wire = serialize_batch(b)
+    assert wire[:4] == b"TTP1"
+    assert deserialize_batch(wire).to_pylist() == b.to_pylist()
+
+
+def test_serde_v2_sidecar_miss_is_transport_error():
+    b = _dict_batch()
+    ctx = PageStreamEncoder()
+    serialize_batch(b, ctx=ctx)            # def consumed nowhere
+    ref_page = serialize_batch(b, ctx=ctx)  # ref without its def registered
+    with pytest.raises(TrinoError):
+        deserialize_batch(ref_page)
+
+
+# ----------------------------------------------- engine-level integration
+
+
+@pytest.fixture(scope="module")
+def standalone():
+    return StandaloneQueryRunner(default_catalog(scale_factor=0.01))
+
+
+def test_lazy_filter_never_materializes_dropped_batches(standalone):
+    """A zero-selectivity filter computes its mask from the predicate
+    column only; payload columns stay lazy and are never pulled."""
+    lazy0, mat0 = _enc("lazy_columns"), _enc("lazy_materialized")
+    res = standalone.execute(
+        "select l_comment from lineitem where l_quantity > 1e9")
+    assert res.rows() == []
+    assert _enc("lazy_columns") > lazy0, "payload column was not lazy-staged"
+    assert _enc("lazy_materialized") == mat0, \
+        "payload bytes were materialized despite zero survivors"
+
+
+def test_low_selectivity_filter_skips_payload_bytes(standalone):
+    skipped0 = _enc("lazy_skipped_bytes")
+    standalone.execute(
+        "select l_extendedprice, l_discount from lineitem "
+        "where l_orderkey = 1")
+    assert _enc("lazy_skipped_bytes") > skipped0
+
+
+def test_explain_analyze_surfaces_encoding_line(standalone):
+    rows = standalone.execute(
+        "explain analyze select l_returnflag, count(*) from lineitem "
+        "group by l_returnflag").rows()
+    text = "\n".join(r[0] for r in rows)
+    assert "encoding:" in text, f"no encoding stats in:\n{text}"
+    assert "code group-bys" in text
+
+
+def _oracle_encoded_vs_flat(standalone, monkeypatch, names):
+    """TRINO_TPU_ENCODED_EXEC=1 rows identical to =0 (the bit-for-bit
+    legacy expand-at-scan path)."""
+    for q in names:
+        monkeypatch.setenv("TRINO_TPU_ENCODED_EXEC", "1")
+        on = standalone.execute(QUERIES[q]).rows()
+        monkeypatch.setenv("TRINO_TPU_ENCODED_EXEC", "0")
+        off = standalone.execute(QUERIES[q]).rows()
+        assert_same_rows(on, off, ordered=False)
+
+
+def test_encoded_vs_flat_tpch_oracle(standalone, monkeypatch):
+    # tier-1 subset spanning the encoded paths: RLE-able scans + dict
+    # group-by (q1), joins on codes (q3, q12), selective filter (q6),
+    # semi-join + distinct on dict keys (q16), dict CASE projection (q14)
+    _oracle_encoded_vs_flat(standalone, monkeypatch, [1, 3, 6, 12, 14, 16])
+
+
+@pytest.mark.slow
+def test_encoded_vs_flat_tpch_oracle_full(standalone, monkeypatch):
+    _oracle_encoded_vs_flat(standalone, monkeypatch, sorted(QUERIES))
+
+
+def test_encoded_exec_off_uses_no_encoded_paths(standalone, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_ENCODED_EXEC", "0")
+    before = {k: v["value"] for k, v in REGISTRY.snapshot().items()
+              if "encoding" in k}
+    standalone.execute(
+        "select l_returnflag, count(*) from lineitem group by l_returnflag")
+    after = {k: v["value"] for k, v in REGISTRY.snapshot().items()
+             if "encoding" in k}
+    assert before == after, "=0 must leave every encoded path cold"
+
+
+def test_dict_codes_survive_repartition_exchange():
+    """Acceptance: dictionary codes cross a repartition exchange without a
+    decode — the sidecar ships values once per stream and later pages carry
+    only codes (trino_encoding_* counters prove it)."""
+    catalog = default_catalog(scale_factor=0.01)
+    dist = DistributedQueryRunner(
+        catalog, worker_count=3,
+        session=Session(node_count=3, use_collectives=False,
+                        exchange_serde=True))
+    sent0, pages0 = _enc("dict_sidecar_sent"), _enc("exchange_code_pages")
+    sql = ("select c_mktsegment, count(*) from customer, orders "
+           "where c_custkey = o_custkey group by c_mktsegment")
+    rows = dist.execute(sql).rows()
+    standalone = StandaloneQueryRunner(catalog)
+    assert_same_rows(rows, standalone.execute(sql).rows())
+    assert _enc("dict_sidecar_sent") > sent0, "no dictionary sidecar shipped"
+    assert _enc("exchange_code_pages") > pages0, \
+        "no page crossed the exchange as codes"
+
+
+def test_collective_exchange_keeps_codes_resident(monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_FUSED_STAGE", "0")
+    catalog = default_catalog(scale_factor=0.01)
+    dist = DistributedQueryRunner(
+        catalog, worker_count=4, session=Session(node_count=4))
+    pages0 = _enc("exchange_code_pages")
+    rows = dist.execute(
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag").rows()
+    assert dist._collective_edges, "expected a collective repartition edge"
+    assert len(rows) == 3
+    assert _enc("exchange_code_pages") > pages0, \
+        "dict key did not stay code-resident through the all_to_all"

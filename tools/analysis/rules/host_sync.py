@@ -129,17 +129,36 @@ class _DeviceInference:
     """Flow-insensitive per-function inference of which local names hold
     device (jax array) values.  Deliberately an under-approximation: only
     values provably rooted in a jax call/annotation are device, so every
-    flag the dataflow layer raises is rooted in evidence."""
+    flag the dataflow layer raises is rooted in evidence.
 
-    def __init__(self, fn: ast.AST, jax_names: set):
+    ``isinstance(x, np.ndarray)`` narrowing: a name the function guards
+    with an explicit numpy-ndarray check is host by construction — the
+    spi/batch.py pattern (``Column.__post_init__`` normalizing all-valid
+    masks only when ``isinstance(self._valid, np.ndarray)``, ``maybe_rle``
+    probing host pages) truthiness-tests ``.all()`` on exactly such values,
+    and that never syncs a device array.  Flow-insensitively, any name so
+    guarded anywhere in the function is dropped from the device set: the
+    guard is evidence the author already split the host/device cases."""
+
+    def __init__(self, fn: ast.AST, jax_names: set, np_names: set = ()):
         self.jax = jax_names
+        self.np = set(np_names) | {"np", "numpy"}
         self.device: set = set()
+        self.host_narrowed: set = set()
         args = fn.args
         for a in (args.posonlyargs + args.args + args.kwonlyargs):
             if a.annotation is not None:
                 ann = ast.unparse(a.annotation)
                 if any(t in ann for t in _ARRAY_ANNOTATIONS):
                     self.device.add(a.arg)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "isinstance"
+                    and len(node.args) == 2
+                    and isinstance(node.args[0], ast.Name)
+                    and self._is_np_ndarray(node.args[1])):
+                self.host_narrowed.add(node.args[0].id)
         # two passes so a name assigned late still taints earlier reads
         # (loops re-bind; flow-insensitivity is the safe direction here)
         for _ in range(2):
@@ -152,13 +171,17 @@ class _DeviceInference:
                     if node.value is not None and self.is_device(node.value):
                         self._bind(node.target)
 
+    def _is_np_ndarray(self, e: ast.AST) -> bool:
+        return (isinstance(e, ast.Attribute) and e.attr == "ndarray"
+                and isinstance(e.value, ast.Name) and e.value.id in self.np)
+
     def _bind(self, target: ast.AST) -> None:
         if isinstance(target, ast.Name):
             self.device.add(target.id)
 
     def is_device(self, e: ast.AST) -> bool:
         if isinstance(e, ast.Name):
-            return e.id in self.device
+            return e.id in self.device and e.id not in self.host_narrowed
         if isinstance(e, ast.BinOp):
             return self.is_device(e.left) or self.is_device(e.right)
         if isinstance(e, ast.UnaryOp):
@@ -281,11 +304,12 @@ def check(index: ProjectIndex) -> list:
         sf = index.files[fi.rel]
         if os.path.basename(fi.rel) in EXEMPT_FILES or sf.tree is None:
             continue
-        inf = _DeviceInference(fi.node, _jax_aliases(index, fi.rel))
+        np_names = _np_aliases(index, fi.rel)
+        inf = _DeviceInference(fi.node, _jax_aliases(index, fi.rel),
+                               np_names)
         if not inf.device:
             continue
-        for lineno, msg in _flag_nodes(fi, inf, _np_aliases(index, fi.rel),
-                                       scope):
+        for lineno, msg in _flag_nodes(fi, inf, np_names, scope):
             if (fi.rel, lineno) in seen:
                 continue
             line = sf.line(lineno)

@@ -18,9 +18,11 @@ from typing import Optional, Sequence
 from ..spi.errors import GENERIC_INTERNAL_ERROR, TrinoError
 from ..telemetry import profiler
 from .operators import Operator
-from .stats import OperatorStats, PipelineStats, QueryStats, ScanIngestStats
+from .stats import (EncodingStats, OperatorStats, PipelineStats, QueryStats,
+                    ScanIngestStats)
 
-__all__ = ["Driver", "run_pipelines", "collect_scan_stats"]
+__all__ = ["Driver", "run_pipelines", "collect_scan_stats",
+           "collect_encoding_stats"]
 
 
 def collect_scan_stats(pipelines: Sequence[Sequence[Operator]]
@@ -34,6 +36,21 @@ def collect_scan_stats(pipelines: Sequence[Sequence[Operator]]
                 if total is None:
                     total = ScanIngestStats()
                 total.merge(ingest)
+    return total
+
+
+def collect_encoding_stats(pipelines: Sequence[Sequence[Operator]]
+                           ) -> Optional[EncodingStats]:
+    """Roll up per-operator compressed-execution counters (None when no
+    operator saw an encoded batch)."""
+    total: Optional[EncodingStats] = None
+    for p in pipelines:
+        for op in p:
+            enc = getattr(op, "encoding_stats", None)
+            if enc is not None and enc.any:
+                if total is None:
+                    total = EncodingStats()
+                total.merge(enc)
     return total
 
 
@@ -258,6 +275,9 @@ def run_pipelines(pipelines: Sequence[Sequence[Operator]],
         ingest = collect_scan_stats(pipelines)
         if ingest is not None:
             stats.merge_scan(ingest)
+        enc = collect_encoding_stats(pipelines)
+        if enc is not None:
+            stats.merge_encoding(enc)
         stats.merge_sync(syncguard.take_delta(sync_before))
 
     # deferred masked-lane expression errors (DIVISION_BY_ZERO, overflow...)

@@ -40,10 +40,22 @@ from . import syncguard as SG
 
 __all__ = ["DeviceJoinTable", "JoinHashTable", "build_table", "probe_ranges",
            "probe_ranges_device", "run_pairs", "run_unique",
-           "ExpandPlanner", "OverflowQueue", "plan_unique_cap"]
+           "ExpandPlanner", "OverflowQueue", "plan_unique_cap", "key_input"]
 
 _SENT_BUILD = 0xFFFFFFFFFFFFFFFF  # build rows with NULL keys / dead rows
 _SENT_PROBE = 0xFFFFFFFFFFFFFFFE  # probe rows with NULL keys
+
+
+def key_input(col):
+    """Device-ready key data for a probe/build column under compressed
+    execution: an RLE run expands device-side from its ONE stored scalar
+    (kernels.rle_fill) instead of materializing a host broadcast view and
+    shipping the full run over PCIe; everything else (flat arrays,
+    dictionary codes, lazy columns on first touch) passes through as
+    ``.data``."""
+    if col.encoding == "RLE":
+        return K.rle_fill(col.rle_value, len(col))
+    return col.data
 
 
 class DeviceJoinTable:

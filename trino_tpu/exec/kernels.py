@@ -48,6 +48,7 @@ __all__ = [
     "probe_join_table",
     "hash_combine",
     "partition_assignments",
+    "rle_fill",
 ]
 
 
@@ -57,6 +58,14 @@ def bucket(n: int, minimum: int = 8) -> int:
     while c < n:
         c <<= 1
     return c
+
+
+def rle_fill(value, length: int):
+    """Expand an RLE run on device: ``jnp.full`` materializes the run from
+    ONE host scalar, so no run-length payload ever crosses the host/device
+    boundary (the expand-at-the-last-moment half of compressed execution)."""
+    value = np.asarray(value)
+    return jnp.full(length, value, dtype=value.dtype)
 
 
 @jit_memo("kernels._searchsorted_method")

@@ -51,6 +51,7 @@ from .operators import (
     UnionSourceOperator,
     ValuesOperator,
     WindowOperator,
+    plan_lazy_scan,
 )
 
 __all__ = ["LocalExecutionPlan", "LocalPlanner"]
@@ -97,6 +98,7 @@ class LocalPlanner:
             self.pipelines = [
                 q for p in self.pipelines for q in self._parallelize(p)]
         for p in self.pipelines:
+            plan_lazy_scan(p)
             for op in p:
                 if isinstance(op, BufferedInputMixin):
                     op.attach_memory(self.memory)

@@ -30,11 +30,12 @@ import numpy as np
 
 from ..ops.expr import compile_expression
 from ..sql.analyzer import STAT_AGGS
-from ..spi.batch import Column, ColumnBatch, pad_to_bucket, unify_dictionaries
+from ..spi.batch import (Column, ColumnBatch, encoded_exec, pad_to_bucket,
+                         unify_dictionaries)
 from ..spi.errors import SUBQUERY_MULTIPLE_ROWS, TrinoError
 from ..spi.connector import Connector, ConnectorPageSink, Split
 from ..spi.types import BIGINT, BOOLEAN, DOUBLE, DecimalType, Type, is_string
-from ..sql.ir import RowExpression
+from ..sql.ir import InputRef, RowExpression, referenced_inputs
 from ..planner.plan import AggCall, SortKey, WindowFunc
 from . import kernels as K
 from . import syncguard as SG
@@ -44,8 +45,9 @@ from .prefetch import (
     DeviceStager,
     IngestConfig,
     PrefetchingPageSource,
+    encode_scan_batch,
 )
-from .stats import ScanIngestStats
+from .stats import EncodingStats, ScanIngestStats
 
 __all__ = [
     "Operator",
@@ -55,6 +57,7 @@ __all__ = [
     "UnionSinkOperator",
     "UnionSourceOperator",
     "FilterProjectOperator",
+    "plan_lazy_scan",
     "HashAggregationOperator",
     "JoinBridge",
     "JoinBuildSink",
@@ -143,6 +146,11 @@ class ScanOperator(Operator):
         # -- async ingest state (exec/prefetch.py) --
         self.ingest_cfg = IngestConfig.from_env()
         self.ingest_stats = ScanIngestStats()
+        # compressed execution: channels the downstream FilterProject only
+        # passes through (set by plan_lazy_scan) stage as LAZY columns —
+        # their bytes cross to the device only if something touches them
+        self.lazy_channels: frozenset[int] = frozenset()
+        self.encoding_stats = EncodingStats()
         self._prefetcher: Optional[PrefetchingPageSource] = None
         self._coalescer: Optional[BatchCoalescer] = None
         self._stager: Optional[DeviceStager] = None
@@ -224,6 +232,9 @@ class ScanOperator(Operator):
                 if self.limit is not None and batch.live is None:
                     self._emitted_rows += batch.num_rows
                 self.ingest_stats.observe_batch(batch.nbytes, batch.num_rows)
+                if encoded_exec():
+                    batch = encode_scan_batch(
+                        batch, self.lazy_channels, self.encoding_stats)
                 return pad_to_bucket(batch)
 
     # -- async ingest path --------------------------------------------------
@@ -238,7 +249,9 @@ class ScanOperator(Operator):
         self.splits = []  # owned by the prefetcher now
         self._coalescer = BatchCoalescer(
             self.ingest_cfg.coalesce_rows, stats=self.ingest_stats)
-        self._stager = DeviceStager(stats=self.ingest_stats)
+        self._stager = DeviceStager(stats=self.ingest_stats,
+                                    lazy_channels=self.lazy_channels,
+                                    enc_stats=self.encoding_stats)
 
     def _stage(self, batch: ColumnBatch) -> ColumnBatch:
         if self.ingest_cfg.stage_device:
@@ -476,6 +489,7 @@ class FilterProjectOperator(Operator):
         # device int32 scalars, one per batch whose program traced an
         # error-capable op (division, overflow...); drained by the runner
         self.pending_errors: list = []
+        self.encoding_stats = EncodingStats()
 
     def _compile(self, batch: ColumnBatch):
         dicts = [c.dictionary for c in batch.columns]
@@ -561,9 +575,108 @@ class FilterProjectOperator(Operator):
     def needs_input(self) -> bool:
         return self._pending is None and super().needs_input()
 
+    def _encoded_plan(self, batch: ColumnBatch):
+        """(needed_channels, passthrough) for the encoded fast path, or
+        None to use the legacy all-channels path.
+
+        ``needed`` are input channels the compiled program actually reads
+        (predicate inputs + every non-trivial projection's inputs); they
+        feed the jit as real arrays, materializing LAZY / expanding RLE
+        on device.  ``passthrough`` maps output position -> input channel
+        for bare InputRef projections, whose columns bypass the program
+        entirely and KEEP their encoding — this is the late-
+        materialization seam: a selective predicate only ever touches its
+        own channels, and payload columns ride through still encoded."""
+        needed: set[int] = set()
+        if self.predicate is not None:
+            needed |= referenced_inputs(self.predicate)
+        passthrough: dict[int, int] = {}
+        if self.projections is None:
+            # pure filter: every column passes through positionally
+            passthrough = {i: i for i in range(batch.num_columns)}
+        else:
+            for j, e in enumerate(self.projections):
+                if (isinstance(e, InputRef)
+                        and str(batch.columns[e.index].type)
+                        == str(self.output_types[j])):
+                    passthrough[j] = e.index
+                else:
+                    needed |= referenced_inputs(e)
+        if any(i >= batch.num_columns for i in needed):
+            return None  # malformed ref; let the legacy path raise
+        return needed, passthrough
+
+    def _add_input_encoded(self, batch: ColumnBatch) -> bool:
+        """Encoding-aware filter+project: compute the mask from needed
+        channels only; RLE/LAZY columns that merely pass through are never
+        expanded or staged.  Returns False to fall back to legacy."""
+        plan = self._encoded_plan(batch)
+        if plan is None:
+            return False
+        needed, passthrough = plan
+        batch = pad_to_bucket(batch)
+        n = batch.num_rows
+        cols_in = []
+        for i, c in enumerate(batch.columns):
+            if i in needed:
+                if c.encoding == "RLE":
+                    cols_in.append((K.rle_fill(c.rle_value, n), c.valid))
+                else:  # touching .data materializes LAZY exactly once
+                    cols_in.append((c.data, c.valid))
+            else:
+                # dead placeholder: device-created zeros cost no PCIe and
+                # XLA removes the unused input from the program
+                dtype = (np.int32 if c.dictionary is not None
+                         else c.type.storage_dtype)
+                cols_in.append((jnp.zeros(n, dtype), None))
+        run, projs = self._compile(batch)
+        outs, live, err_code = run(cols_in, batch.live)
+        if err_code is not None:
+            self.pending_errors.append(err_code)
+        cols = []
+        if projs is None:
+            for i, ((d, v), c) in enumerate(zip(outs, batch.columns)):
+                if i in passthrough:
+                    cols.append(c)
+                else:
+                    cols.append(Column(c.type, d, v, c.dictionary))
+        else:
+            for j, ((d, v), t, ce) in enumerate(
+                    zip(outs, self.output_types, projs)):
+                if j in passthrough:
+                    cols.append(batch.columns[passthrough[j]])
+                else:
+                    cols.append(Column(t, d, v, ce.dictionary))
+        self._observe_encoded(batch, needed)
+        self._pending = ColumnBatch(self.output_names, cols, live)
+        return True
+
+    def _observe_encoded(self, batch: ColumnBatch, needed: set[int]) -> None:
+        es = self.encoding_stats
+        saved = 0
+        n_rle = n_dict = 0
+        for i, c in enumerate(batch.columns):
+            enc = c.encoding
+            if enc == "RLE":
+                n_rle += 1
+            elif enc == "DICT":
+                n_dict += 1
+            if enc in ("RLE", "LAZY") and i not in needed:
+                saved += c.flat_nbytes - c.nbytes
+        if n_rle:
+            es.rle_batches += 1
+        if n_dict:
+            es.dict_batches += 1
+        if saved > 0:
+            es.bytes_saved += saved
+
     def add_input(self, batch: ColumnBatch) -> None:
         if batch.num_columns == 0:
             self._pending = batch.rename(self.output_names)
+            return
+        if (encoded_exec()
+                and any(c.encoding in ("RLE", "LAZY") for c in batch.columns)
+                and self._add_input_encoded(batch)):
             return
         batch = pad_to_bucket(batch)
         run, projs = self._compile(batch)
@@ -586,6 +699,30 @@ class FilterProjectOperator(Operator):
 
     def is_finished(self) -> bool:
         return self.input_done and self._pending is None
+
+
+def plan_lazy_scan(pipeline: Sequence[Operator]) -> None:
+    """Late-materialization planning: when a scan feeds straight into a
+    filtering FilterProject, every channel the filter only passes through
+    stages as LAZY — the mask computes from predicate columns alone, and a
+    selective filter's payload bytes never cross to the device (the
+    LazyBlock contract of ScanFilterAndProjectOperator).  Called once per
+    pipeline at local-planning time; a no-op unless TRINO_TPU_ENCODED_EXEC
+    allows encoded execution."""
+    if not encoded_exec() or len(pipeline) < 2:
+        return
+    scan, fp = pipeline[0], pipeline[1]
+    if not (isinstance(scan, ScanOperator)
+            and isinstance(fp, FilterProjectOperator)
+            and fp.predicate is not None):
+        return
+    needed = set(referenced_inputs(fp.predicate))
+    if fp.projections is not None:
+        for e in fp.projections:
+            if not isinstance(e, InputRef):
+                needed |= referenced_inputs(e)
+    scan.lazy_channels = frozenset(
+        i for i in range(len(scan.columns)) if i not in needed)
 
 
 class RenameOperator(Operator):
@@ -744,7 +881,10 @@ def _concat_device(batches: Sequence[ColumnBatch]) -> ColumnBatch:
         cs = [b.columns[i] for b in batches]
         if cs[0].type.is_dictionary_encoded:
             cs = unify_dictionaries(cs)
-        parts = [jnp.asarray(c.data) for c in cs]
+        # RLE runs expand with a device-side fill: one scalar crosses the
+        # host boundary instead of the whole run
+        parts = [K.rle_fill(c.rle_value, len(c)) if c.encoding == "RLE"
+                 else jnp.asarray(c.data) for c in cs]
         if pad:
             parts.append(jnp.zeros(pad, parts[0].dtype))
         data = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
@@ -752,7 +892,7 @@ def _concat_device(batches: Sequence[ColumnBatch]) -> ColumnBatch:
         if any(c.valid is not None for c in cs):
             vparts = [
                 jnp.asarray(c.valid) if c.valid is not None
-                else jnp.ones(c.data.shape[0], jnp.bool_)
+                else jnp.ones(len(c), jnp.bool_)
                 for c in cs
             ]
             if pad:
@@ -800,6 +940,7 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         self._flushed: list[ColumnBatch] = []
         self._result: Optional[ColumnBatch] = None
         self._emitted = False
+        self.encoding_stats = EncodingStats()
         # partitioned state spill (SpillableHashAggregationBuilder.java):
         # one spill file per hash partition of pre-aggregated states
         self._state_spillers: Optional[list] = None
@@ -1016,10 +1157,101 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
                                    np.zeros(1, bool)))
         return ColumnBatch(self.output_names, cols)
 
+    # RLE-aware aggregation: fns computable arithmetically from one stored
+    # value + a live/valid count, without ever expanding the run
+    _RLE_AGG_FNS = frozenset(("sum", "count", "count_star", "min", "max"))
+
+    def _rle_fast_path(self) -> Optional[ColumnBatch]:
+        """Global aggregation over RLE inputs: SUM(x) over a constant run
+        is ``value * run_count`` (the RunLengthEncodedBlock shortcut of the
+        reference's aggregation operators) — pure host arithmetic over per-
+        batch scalars, no concat, no device dispatch, no expansion."""
+        if (len(self.group_keys) or self.step == "FINAL"
+                or not self.aggs
+                or any(a.distinct for a in self.aggs)
+                or not all(a.fn in self._RLE_AGG_FNS for a in self.aggs)):
+            return None
+        for b in self._batches:
+            if b.live is not None and not isinstance(b.live, np.ndarray):
+                return None  # counting a device mask would cost a sync
+            for a in self.aggs:
+                if a.arg < 0:
+                    continue
+                c = b.columns[a.arg]
+                if c.encoding != "RLE":
+                    return None
+                if c.valid is not None and not isinstance(c.valid, np.ndarray):
+                    return None
+                if c.dictionary is not None and a.fn == "sum":
+                    return None  # dict codes don't sum; min/max do (sorted)
+        first = self._batches[0]
+        for a in self.aggs:  # min/max on codes needs ONE shared dictionary
+            if a.arg < 0 or first.columns[a.arg].dictionary is None:
+                continue
+            from ..spi.batch import _same_dictionary
+
+            d0 = first.columns[a.arg].dictionary
+            if not all(_same_dictionary(b.columns[a.arg].dictionary, d0)
+                       for b in self._batches[1:]):
+                return None
+
+        def counted(b: ColumnBatch, c: Column) -> int:
+            """Rows of this run that are live AND valid."""
+            if c.valid is None and b.live is None:
+                return len(c)
+            m = np.ones(len(c), np.bool_)
+            if c.valid is not None:
+                m &= np.asarray(c.valid)
+            if b.live is not None:
+                m &= np.asarray(b.live)
+            return int(m.sum())
+
+        out_cols: list[Column] = []
+        rows_folded = 0
+        for a, t in zip(self.aggs, self.output_types):
+            if a.fn == "count_star":
+                total = sum(b.live_count for b in self._batches)
+                out_cols.append(Column(t, np.array([total], np.int64)))
+                continue
+            pairs = [(b.columns[a.arg], counted(b, b.columns[a.arg]))
+                     for b in self._batches]
+            rows_folded += sum(cnt for _, cnt in pairs)
+            if a.fn == "count":
+                total = sum(cnt for _, cnt in pairs)
+                out_cols.append(Column(t, np.array([total], np.int64)))
+                continue
+            alive = [(c, cnt) for c, cnt in pairs if cnt > 0]
+            if not alive:  # sum/min/max over all-NULL input -> NULL
+                out_cols.append(Column(t, np.zeros(1, t.storage_dtype),
+                                       np.zeros(1, np.bool_),
+                                       pairs[0][0].dictionary))
+                continue
+            dict_ = alive[0][0].dictionary
+            if a.fn == "sum":
+                dtype = np.dtype(t.storage_dtype)
+                if dtype.kind == "f":
+                    v = float(sum(float(c.rle_value) * cnt
+                                  for c, cnt in alive))
+                else:  # exact: python bignum until the final cast
+                    v = sum(int(c.rle_value) * cnt for c, cnt in alive)
+                out_cols.append(Column(t, np.array([v], dtype)))
+            else:
+                pick = min if a.fn == "min" else max
+                v = pick(c.rle_value for c, _ in alive)
+                out_cols.append(Column(
+                    t, np.array([v], np.asarray(v).dtype), None, dict_))
+        self.encoding_stats.rle_agg_rows += rows_folded
+        self.encoding_stats.rle_batches += len(self._batches)
+        return ColumnBatch(self.output_names, out_cols)
+
     def _compute(self) -> ColumnBatch:
         nk = len(self.group_keys)
         if not self.buffered_batches():
             return self._empty_result(nk)
+        if encoded_exec():
+            fast = self._rle_fast_path()
+            if fast is not None:
+                return fast
         inp = _maybe_compact_device(_concat_device(self._batches))
         live = inp.live  # None = all rows real
         n = inp.num_rows
@@ -1031,6 +1263,10 @@ class HashAggregationOperator(BufferedInputMixin, Operator):
         # skip the fold_live below
         key_cols = [inp.columns[i] for i in self.group_keys]
         space = K.small_codes_group_space(key_cols) if nk else 1
+        if nk and space is not None:
+            # every key is a small dictionary code: the whole group-by runs
+            # in code space (one post-agg gather decodes group keys)
+            self.encoding_stats.code_group_batches += 1
         use_masked = (space is not None and space <= K.MASKED_AGG_LIMIT
                       and not any(a.distinct for a in self.aggs)
                       and (nk or live is not None
@@ -1507,6 +1743,7 @@ class LookupJoinOperator(Operator):
         self._uplanner = JX.ExpandPlanner(key=("unique",) + ident)
         self._inflight = JX.OverflowQueue()
         self.pending_errors: list = []  # deferred cardinality violations
+        self.encoding_stats = EncodingStats()
 
     def needs_input(self) -> bool:
         return self.bridge.ready and not self._pending and super().needs_input()
@@ -1552,12 +1789,15 @@ class LookupJoinOperator(Operator):
             return
         build = self.bridge.batch
         table = self.bridge.table
-        keys = [(probe.columns[ch].data, probe.columns[ch].valid)
+        keys = [(JX.key_input(probe.columns[ch]), probe.columns[ch].valid)
                 for ch in self.left_keys]
         remaps = [
             _probe_key_remap(probe.columns[ch], self.bridge.key_dicts[k])
             for k, ch in enumerate(self.left_keys)
         ]
+        if any(r is not None for r in remaps):
+            # dictionary keys probe as remapped int32 CODES, never values
+            self.encoding_stats.code_join_batches += 1
         if table.num_rows:
             if self.join_type in ("INNER", "RIGHT"):
                 # speculative FK->PK probe: ranges+verify first, ONE combined
@@ -1938,7 +2178,7 @@ class SemiJoinOperator(Operator):
             c = batch.columns[ch]
             bdict = (self.bridge.key_dicts[k]
                      if k < len(self.bridge.key_dicts) else None)
-            keys.append((c.data, c.valid))
+            keys.append((JX.key_input(c), c.valid))
             remaps.append(_probe_key_remap(c, bdict))
         # IN over the empty set is FALSE (never UNKNOWN) even for NULL probes
         semi = (self.null_aware, table.has_null_key, table.live_rows > 0)

@@ -42,9 +42,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..spi.batch import Column, ColumnBatch, round_up_pow2, unify_dictionaries
+from ..spi.batch import (Column, ColumnBatch, encoded_exec, maybe_rle,
+                         round_up_pow2, unify_dictionaries)
 from ..spi.errors import GENERIC_INTERNAL_ERROR, TrinoError
-from .stats import ScanIngestStats
+from .stats import EncodingStats, ScanIngestStats
 
 __all__ = [
     "IngestConfig",
@@ -366,6 +367,60 @@ class BatchCoalescer:
         return coalesce_pad(parts)
 
 
+def encode_column(i: int, c: Column, lazy_channels,
+                  enc_stats: Optional[EncodingStats] = None
+                  ) -> Optional[Column]:
+    """Compressed execution: RLE-collapse a constant column, or LAZY-wrap a
+    channel the planner proved the filter never touches.  Returns None when
+    the column should be handled the legacy way (staged / passed through)."""
+    rle = maybe_rle(c)
+    if rle is not c:
+        # constant run: ONE host scalar represents the whole column; the
+        # expand (if any) happens device-side via kernels.rle_fill
+        if enc_stats is not None:
+            enc_stats.bytes_saved += rle.flat_nbytes - rle.nbytes
+        return rle
+    if i in lazy_channels:
+        data, valid = c.data, c.valid
+
+        def thunk(data=data, valid=valid):
+            return data, valid
+
+        if enc_stats is not None:
+            enc_stats.lazy_columns += 1
+            enc_stats.lazy_skipped_bytes += c.nbytes
+        return Column.lazy(c.type, len(c), thunk, c.dictionary,
+                           nbytes_hint=c.nbytes)
+    return None
+
+
+def encode_scan_batch(batch: ColumnBatch, lazy_channels,
+                      enc_stats: Optional[EncodingStats] = None
+                      ) -> ColumnBatch:
+    """Compressed-execution pass for the synchronous scan path (no async
+    ingest, so batches never reach DeviceStager).  Host batches only —
+    device-pinned batches (live mask set) pass through untouched."""
+    if (not batch.columns or batch.live is not None
+            or not isinstance(batch.columns[0].data, np.ndarray)):
+        return batch
+    any_rle = False
+    changed = False
+    cols = []
+    for i, c in enumerate(batch.columns):
+        enc = encode_column(i, c, lazy_channels, enc_stats)
+        if enc is not None:
+            any_rle = any_rle or enc.encoding == "RLE"
+            changed = True
+            cols.append(enc)
+        else:
+            cols.append(c)
+    if not changed:
+        return batch
+    if any_rle and enc_stats is not None:
+        enc_stats.rle_batches += 1
+    return ColumnBatch(batch.names, cols, batch.live)
+
+
 class DeviceStager:
     """Double-buffered host->device staging.
 
@@ -375,8 +430,19 @@ class DeviceStager:
     the driver overlaps its upload with downstream compute on N.  Batches
     that already live on device pass through untouched."""
 
-    def __init__(self, stats: Optional[ScanIngestStats] = None):
+    def __init__(self, stats: Optional[ScanIngestStats] = None,
+                 lazy_channels=None,
+                 enc_stats: Optional[EncodingStats] = None):
         self.stats = stats
+        # compressed execution (plan_lazy_scan): these channels defer their
+        # transfer behind a thunk instead of staging eagerly
+        self.lazy_channels = frozenset(lazy_channels or ())
+        self.enc_stats = enc_stats
+
+    def _stage_encoded(self, i: int, c: Column) -> Optional[Column]:
+        """RLE-collapse or LAZY-wrap one column instead of staging it; None
+        means stage eagerly (the legacy device_put)."""
+        return encode_column(i, c, self.lazy_channels, self.enc_stats)
 
     def stage(self, batch: ColumnBatch) -> ColumnBatch:
         if not batch.columns or not isinstance(
@@ -385,11 +451,21 @@ class DeviceStager:
         import jax
 
         t0 = time.perf_counter()
+        encoded = encoded_exec()
+        any_rle = False
         cols = []
-        for c in batch.columns:
+        for i, c in enumerate(batch.columns):
+            if encoded:
+                enc = self._stage_encoded(i, c)
+                if enc is not None:
+                    any_rle = any_rle or enc.encoding == "RLE"
+                    cols.append(enc)
+                    continue
             data = jax.device_put(c.data)
             valid = None if c.valid is None else jax.device_put(c.valid)
             cols.append(Column(c.type, data, valid, c.dictionary))
+        if any_rle and self.enc_stats is not None:
+            self.enc_stats.rle_batches += 1
         live = batch.live
         if live is not None:
             live = jax.device_put(live)

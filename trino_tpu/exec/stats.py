@@ -251,6 +251,59 @@ class AdaptiveStats:
 
 
 @dataclass
+class EncodingStats:
+    """Counters for compressed execution (TRINO_TPU_ENCODED_EXEC): batches
+    by encoding, bytes saved vs a flat representation, lazy columns that
+    were filtered away before their thunk ever ran, and dictionary codes
+    surviving exchanges.  One instance per encoding-aware operator;
+    ``merge`` folds them into the query-level roll-up."""
+
+    rle_batches: int = 0        # batches carrying >=1 RLE column
+    dict_batches: int = 0       # batches carrying >=1 dictionary column
+    lazy_columns: int = 0       # LAZY columns created by staging
+    lazy_materialized: int = 0  # thunks that actually ran
+    bytes_saved: int = 0        # flat-equivalent minus encoded bytes
+    lazy_skipped_bytes: int = 0  # payload bytes never staged
+    rle_agg_rows: int = 0       # rows aggregated as value * run_count
+    code_group_batches: int = 0  # group-bys that ran on int32 codes
+    code_join_batches: int = 0   # joins probed in code space
+    exchange_code_pages: int = 0  # pages whose codes crossed a shuffle
+
+    def merge(self, other: "EncodingStats") -> None:
+        self.rle_batches += other.rle_batches
+        self.dict_batches += other.dict_batches
+        self.lazy_columns += other.lazy_columns
+        self.lazy_materialized += other.lazy_materialized
+        self.bytes_saved += other.bytes_saved
+        self.lazy_skipped_bytes += other.lazy_skipped_bytes
+        self.rle_agg_rows += other.rle_agg_rows
+        self.code_group_batches += other.code_group_batches
+        self.code_join_batches += other.code_join_batches
+        self.exchange_code_pages += other.exchange_code_pages
+
+    @property
+    def any(self) -> bool:
+        return any((self.rle_batches, self.dict_batches, self.lazy_columns,
+                    self.bytes_saved, self.lazy_skipped_bytes,
+                    self.rle_agg_rows, self.code_group_batches,
+                    self.code_join_batches, self.exchange_code_pages))
+
+    def text(self) -> str:
+        never = self.lazy_columns - self.lazy_materialized
+        return (
+            f"encoding: {self.rle_batches} RLE / {self.dict_batches} dict "
+            f"batches, {self.lazy_columns} lazy columns "
+            f"({never} never materialized, "
+            f"{self.lazy_skipped_bytes / 1e6:.2f} MB skipped), "
+            f"{self.bytes_saved / 1e6:.2f} MB saved vs flat, "
+            f"{self.rle_agg_rows} RLE-agg rows, "
+            f"{self.code_group_batches} code group-bys / "
+            f"{self.code_join_batches} code joins, "
+            f"{self.exchange_code_pages} code pages through exchange"
+        )
+
+
+@dataclass
 class OperatorStats:
     name: str
     input_rows: int = 0
@@ -276,11 +329,17 @@ class QueryStats:
     resilience: ResilienceStats | None = None  # retry/heartbeat delta
     fused: FusedStageStats | None = None  # whole-stage compilation counters
     adaptive: AdaptiveStats | None = None  # adaptive-execution decisions
+    encoding: EncodingStats | None = None  # compressed-execution counters
 
     def merge_scan(self, ingest: ScanIngestStats) -> None:
         if self.scan is None:
             self.scan = ScanIngestStats()
         self.scan.merge(ingest)
+
+    def merge_encoding(self, enc: EncodingStats) -> None:
+        if self.encoding is None:
+            self.encoding = EncodingStats()
+        self.encoding.merge(enc)
 
     def merge_fused(self, fused: FusedStageStats) -> None:
         if self.fused is None:
@@ -308,6 +367,8 @@ class QueryStats:
             lines.append("  " + self.fused.text())
         if self.adaptive is not None and self.adaptive.any:
             lines.append("  " + self.adaptive.text())
+        if self.encoding is not None and self.encoding.any:
+            lines.append("  " + self.encoding.text())
         for i, p in enumerate(self.pipelines):
             lines.append(f"  pipeline {i}:")
             for op in p.operators:

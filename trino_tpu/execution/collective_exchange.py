@@ -311,6 +311,14 @@ class CollectiveRepartitionExchange:
                     [x, jnp.full((cap - x.shape[0],), fill, x.dtype)])
             return x
 
+        def dev_col(c, dtype):
+            # compressed execution: an RLE deposit expands device-side from
+            # ONE scalar (rows past the deposit are dead lanes anyway), so
+            # the run never crosses the host/device boundary expanded
+            if c.encoding == "RLE":
+                return K.rle_fill(c.rle_value, cap)
+            return pad(c.data, dtype)
+
         # global [n*cap] arrays: shard i lives on mesh device i
         def make_global(per_task, dtype):
             sharding = NamedSharding(mesh, P(_AXIS))
@@ -324,7 +332,7 @@ class CollectiveRepartitionExchange:
         flat = []
         for ci, t in enumerate(self.types):
             flat.append(make_global(
-                [pad(deposits[i].columns[ci].data, t.storage_dtype)
+                [dev_col(deposits[i].columns[ci], t.storage_dtype)
                  for i in range(n)], t.storage_dtype))
         for ci in range(len(self.types)):
             if valid_flags[ci]:
@@ -386,6 +394,12 @@ class CollectiveRepartitionExchange:
         data_shards = [shards_of(d) for d in out_datas]
         valid_shards = [None if v is None else shards_of(v) for v in out_valids]
         live_shards = shards_of(out_live)
+        if any(d is not None for d in unified_dicts):
+            # dictionary codes crossed the shuffle as resident int32 lanes —
+            # each consumer shard is one code page that never decoded
+            from ..telemetry import metrics as tm
+
+            tm.ENCODING_EXCHANGE_CODE_PAGES.inc(n)
         for i in range(n):
             cols = []
             for ci, t in enumerate(self.types):

@@ -1126,7 +1126,7 @@ class DistributedQueryRunner:
                   adaptive=None, tee=None) -> None:
         import time as _time
 
-        from ..exec.driver import collect_scan_stats
+        from ..exec.driver import collect_encoding_stats, collect_scan_stats
         from ..telemetry import metrics as tm
         from ..telemetry import runtime as rt
         from .speculation import SpeculationLost
@@ -1193,6 +1193,8 @@ class DistributedQueryRunner:
                     if adaptive is not None:
                         adaptive.abort()
             ingest = collect_scan_stats(pipelines) if pipelines else None
+            if pipelines:
+                tm.observe_encoding(collect_encoding_stats(pipelines))
             if ingest is not None:
                 annotate_scan_span(sp, ingest)
                 tm.observe_scan(ingest)

@@ -13,8 +13,11 @@ boundary; live masks never cross it).
 
 from __future__ import annotations
 
+import itertools
 import struct
+import threading
 import zlib
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -26,7 +29,7 @@ from ..spi.types import Type, parse_type
 __all__ = ["serialize_batch", "deserialize_batch", "write_frame",
            "iter_frames", "CODEC_NONE", "CODEC_ZLIB",
            "SPOOL_STREAM_MAGIC", "SpoolCorruptionError",
-           "write_stream_header", "write_frame_crc"]
+           "write_stream_header", "write_frame_crc", "PageStreamEncoder"]
 
 # v2 spool-stream header: a file starting with these 4 bytes carries
 # CRC-checked frames ([u32 len][u32 crc32][payload]); any other first word
@@ -103,8 +106,85 @@ def iter_frames(f, path: str = "<stream>"):
         first = f.read(4)
 
 _MAGIC = b"TTP1"
+_MAGIC2 = b"TTP2"  # compressed-execution pages (encoding byte + dict sidecar)
 CODEC_NONE = 0
 CODEC_ZLIB = 1
+
+# v2 per-column encoding byte
+_ENC_FLAT = 0
+_ENC_RLE = 1  # one stored value + the page row count
+# v2 per-column dictionary byte
+_DICT_NONE = 0
+_DICT_INLINE = 1  # values inline, exactly like v1 (no stream context)
+_DICT_DEF = 2     # sidecar definition: stream token + dict id + values
+_DICT_REF = 3     # sidecar reference: stream token + dict id only
+
+_STREAM_TOKENS = itertools.count(1)
+_STREAM_TOKENS_LOCK = threading.Lock()
+
+
+class PageStreamEncoder:
+    """Producer-side context for ONE ordered page stream (a single
+    (task, partition) output buffer).  The first page that carries a given
+    dictionary object ships its values once as a sidecar definition; every
+    later page on the same stream sends a fixed-size reference, so a
+    repartition exchange moves int32 codes instead of re-shipping the
+    dictionary with every page.  Correctness rides on the exchange plane's
+    per-buffer in-order delivery (sequential page tokens + acks): a REF can
+    never overtake its DEF."""
+
+    def __init__(self):
+        with _STREAM_TOKENS_LOCK:
+            self.token = next(_STREAM_TOKENS)
+        self._ids: dict[int, int] = {}  # id(dictionary) -> dict id
+        self._pins: list = []  # keep dicts alive so id() stays unique
+
+    def dict_id(self, dictionary) -> tuple[int, bool]:
+        """(dict_id, is_new) for a dictionary object on this stream."""
+        key = id(dictionary)
+        did = self._ids.get(key)
+        if did is not None:
+            return did, False
+        did = len(self._pins)
+        self._ids[key] = did
+        self._pins.append(dictionary)
+        return did, True
+
+
+# Consumer-side sidecar registry: stream token -> dict id -> values.  The
+# token is globally unique per producer stream, so pages from interleaved
+# producers (a GATHER consumer pulling many upstream tasks) can never
+# collide.  Bounded LRU by stream: dictionaries live as long as their
+# stream stays among the most recent _DICT_REGISTRY_MAX streams.
+_DICT_REGISTRY: "OrderedDict[int, dict[int, np.ndarray]]" = OrderedDict()
+_DICT_REGISTRY_LOCK = threading.Lock()
+_DICT_REGISTRY_MAX = 256
+
+
+def _register_dict(token: int, did: int, values: np.ndarray) -> None:
+    with _DICT_REGISTRY_LOCK:
+        stream = _DICT_REGISTRY.get(token)
+        if stream is None:
+            stream = _DICT_REGISTRY[token] = {}
+            while len(_DICT_REGISTRY) > _DICT_REGISTRY_MAX:
+                _DICT_REGISTRY.popitem(last=False)
+        else:
+            _DICT_REGISTRY.move_to_end(token)
+        stream[did] = values
+
+
+def _lookup_dict(token: int, did: int) -> np.ndarray:
+    with _DICT_REGISTRY_LOCK:
+        stream = _DICT_REGISTRY.get(token)
+        if stream is not None:
+            _DICT_REGISTRY.move_to_end(token)
+            values = stream.get(did)
+            if values is not None:
+                return values
+    raise TrinoError(
+        PAGE_TRANSPORT_ERROR,
+        f"dictionary sidecar miss: stream {token} dict {did} "
+        "(reference arrived before / outlived its definition)")
 
 
 def _pack_bytes(out: list[bytes], b: bytes) -> None:
@@ -136,9 +216,46 @@ class _Reader:
         return self.blob().decode("utf-8")
 
 
-def serialize_batch(batch: ColumnBatch, codec: int = CODEC_ZLIB) -> bytes:
+def _pack_dict_values(parts: list[bytes], dictionary) -> None:
+    parts.append(struct.pack("<I", len(dictionary)))
+    for v in dictionary:
+        # tuples (array/row/map) and python ints (long decimals)
+        # round-trip through repr; strings stay plain
+        _pack_str(parts, repr(v) if isinstance(v, (tuple, int))
+                  else str(v))
+
+
+def _unpack_dict_values(r: "_Reader", type_: Type) -> np.ndarray:
+    count = r.u32()
+    texts = [r.text() for _ in range(count)]
+    dictionary = np.empty(count, dtype=object)
+    from ..spi.types import ArrayType, DecimalType, MapType, RowType
+
+    if isinstance(type_, (ArrayType, RowType, MapType)):
+        import ast as _ast
+
+        for i, s in enumerate(texts):
+            dictionary[i] = _ast.literal_eval(s)
+    elif isinstance(type_, DecimalType) and type_.precision > 18:
+        for i, s in enumerate(texts):
+            dictionary[i] = int(s)
+    else:
+        for i, s in enumerate(texts):
+            dictionary[i] = s
+    return dictionary
+
+
+def serialize_batch(batch: ColumnBatch, codec: int = CODEC_ZLIB,
+                    ctx: Optional[PageStreamEncoder] = None) -> bytes:
     """One page: MAGIC, codec, u32 rows, u32 cols, then per column
-    (name, type, dtype, data, has_valid [+bitmap], has_dict [+values])."""
+    (name, type, dtype, data, has_valid [+bitmap], has_dict [+values]).
+
+    With a :class:`PageStreamEncoder` ``ctx`` the page uses the v2 encoded
+    format instead: RLE columns ship one value, dictionary columns ship
+    their values once per stream (sidecar def/ref).  ``ctx=None`` stays
+    bit-for-bit identical to the legacy v1 page."""
+    if ctx is not None:
+        return _serialize_batch_v2(batch, codec, ctx)
     batch = batch.compact()
     parts: list[bytes] = []
     parts.append(struct.pack("<II", batch.num_rows, batch.num_columns))
@@ -155,12 +272,7 @@ def serialize_batch(batch: ColumnBatch, codec: int = CODEC_ZLIB) -> bytes:
             parts.append(b"\x00")
         if col.dictionary is not None:
             parts.append(b"\x01")
-            parts.append(struct.pack("<I", len(col.dictionary)))
-            for v in col.dictionary:
-                # tuples (array/row/map) and python ints (long decimals)
-                # round-trip through repr; strings stay plain
-                _pack_str(parts, repr(v) if isinstance(v, (tuple, int))
-                          else str(v))
+            _pack_dict_values(parts, col.dictionary)
         else:
             parts.append(b"\x00")
     payload = b"".join(parts)
@@ -169,14 +281,65 @@ def serialize_batch(batch: ColumnBatch, codec: int = CODEC_ZLIB) -> bytes:
     return _MAGIC + struct.pack("<BI", codec, len(payload)) + payload
 
 
+def _serialize_batch_v2(batch: ColumnBatch, codec: int,
+                        ctx: PageStreamEncoder) -> bytes:
+    from ..telemetry import metrics as tm
+
+    batch = batch.compact()
+    parts: list[bytes] = []
+    parts.append(struct.pack("<II", batch.num_rows, batch.num_columns))
+    code_page = False
+    for name, col in zip(batch.names, batch.columns):
+        _pack_str(parts, name)
+        _pack_str(parts, str(col.type))
+        if col.encoding == "RLE":
+            # ONE stored value; the consumer re-expands (or keeps the run)
+            parts.append(struct.pack("<B", _ENC_RLE))
+            value = np.ascontiguousarray(
+                np.asarray(col.rle_value).reshape(1))
+            _pack_str(parts, value.dtype.str)
+            _pack_bytes(parts, value.tobytes())
+        else:
+            parts.append(struct.pack("<B", _ENC_FLAT))
+            data = np.ascontiguousarray(np.asarray(col.data))
+            _pack_str(parts, data.dtype.str)
+            _pack_bytes(parts, data.tobytes())
+        if col.valid is not None:
+            parts.append(b"\x01")
+            _pack_bytes(parts, np.packbits(np.asarray(col.valid)).tobytes())
+        else:
+            parts.append(b"\x00")
+        if col.dictionary is None:
+            parts.append(struct.pack("<B", _DICT_NONE))
+        else:
+            did, is_new = ctx.dict_id(col.dictionary)
+            if is_new:
+                parts.append(struct.pack("<BQI", _DICT_DEF, ctx.token, did))
+                _pack_dict_values(parts, col.dictionary)
+                tm.ENCODING_DICT_SIDECAR_SENT.inc()
+            else:
+                parts.append(struct.pack("<BQI", _DICT_REF, ctx.token, did))
+                tm.ENCODING_DICT_SIDECAR_REUSED.inc()
+            code_page = True
+    if code_page:
+        tm.ENCODING_EXCHANGE_CODE_PAGES.inc()
+    payload = b"".join(parts)
+    if codec == CODEC_ZLIB:
+        payload = zlib.compress(payload, level=1)
+    return _MAGIC2 + struct.pack("<BI", codec, len(payload)) + payload
+
+
 def deserialize_batch(data: bytes) -> ColumnBatch:
-    assert data[:4] == _MAGIC, "bad page magic"
+    magic = data[:4]
+    assert magic in (_MAGIC, _MAGIC2), "bad page magic"
     codec, plen = struct.unpack("<BI", data[4:9])
     payload = data[9:9 + plen]
     if codec == CODEC_ZLIB:
         payload = zlib.decompress(payload)
     r = _Reader(payload)
     num_rows, num_cols = struct.unpack("<II", r.take(8))
+    if magic == _MAGIC2:
+        return _deserialize_v2(r, num_rows, num_cols)
     names: list[str] = []
     cols: list[Column] = []
     for _ in range(num_cols):
@@ -190,21 +353,39 @@ def deserialize_batch(data: bytes) -> ColumnBatch:
             valid = np.unpackbits(bits, count=num_rows).astype(bool)
         dictionary = None
         if r.take(1) == b"\x01":
-            count = r.u32()
-            texts = [r.text() for _ in range(count)]
-            dictionary = np.empty(count, dtype=object)
-            from ..spi.types import ArrayType, DecimalType, MapType, RowType
-
-            if isinstance(type_, (ArrayType, RowType, MapType)):
-                import ast as _ast
-
-                for i, s in enumerate(texts):
-                    dictionary[i] = _ast.literal_eval(s)
-            elif isinstance(type_, DecimalType) and type_.precision > 18:
-                for i, s in enumerate(texts):
-                    dictionary[i] = int(s)
-            else:
-                for i, s in enumerate(texts):
-                    dictionary[i] = s
+            dictionary = _unpack_dict_values(r, type_)
         cols.append(Column(type_, arr, valid, dictionary))
+    return ColumnBatch(names, cols)
+
+
+def _deserialize_v2(r: "_Reader", num_rows: int,
+                    num_cols: int) -> ColumnBatch:
+    names: list[str] = []
+    cols: list[Column] = []
+    for _ in range(num_cols):
+        names.append(r.text())
+        type_ = parse_type(r.text())
+        enc = struct.unpack("<B", r.take(1))[0]
+        dtype = np.dtype(r.text())
+        arr = np.frombuffer(r.blob(), dtype=dtype).copy()
+        valid: Optional[np.ndarray] = None
+        if r.take(1) == b"\x01":
+            bits = np.frombuffer(r.blob(), dtype=np.uint8)
+            valid = np.unpackbits(bits, count=num_rows).astype(bool)
+        dmode = struct.unpack("<B", r.take(1))[0]
+        dictionary = None
+        if dmode == _DICT_INLINE:
+            dictionary = _unpack_dict_values(r, type_)
+        elif dmode == _DICT_DEF:
+            token, did = struct.unpack("<QI", r.take(12))
+            dictionary = _unpack_dict_values(r, type_)
+            _register_dict(token, did, dictionary)
+        elif dmode == _DICT_REF:
+            token, did = struct.unpack("<QI", r.take(12))
+            dictionary = _lookup_dict(token, did)
+        if enc == _ENC_RLE:
+            cols.append(Column.rle(type_, arr[0], num_rows, valid,
+                                   dictionary))
+        else:
+            cols.append(Column(type_, arr, valid, dictionary))
     return ColumnBatch(names, cols)

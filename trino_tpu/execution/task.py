@@ -17,9 +17,9 @@ import numpy as np
 
 from ..exec import kernels as K
 from ..exec.operators import Operator
-from ..spi.batch import Column, ColumnBatch
+from ..spi.batch import Column, ColumnBatch, encoded_exec
 from .exchange import ExchangeClient, OutputBuffer
-from .serde import deserialize_batch, serialize_batch
+from .serde import PageStreamEncoder, deserialize_batch, serialize_batch
 
 __all__ = ["RemoteExchangeSourceOperator", "PartitionedOutputSink",
            "SerializedPage", "maybe_deserialize"]
@@ -268,6 +268,17 @@ class PartitionedOutputSink(Operator):
         # the consumer one operator dispatch per sliver
         self.coalesce_rows = coalesce_rows
         self._pend: dict[int, list] = {}  # partition -> [rows, [slivers]]
+        # compressed execution: each partition's page stream gets its own
+        # sidecar context, so dictionaries ship once per (task, partition).
+        # Only the in-memory HTTP exchange plane guarantees the in-order,
+        # from-the-start delivery the def/ref protocol needs — FTE durable
+        # spools and speculation tees (facade buffers) replay frames across
+        # attempts and stay on v1 pages.  BROADCAST serializes one page for
+        # all partitions, which would share one stream across consumers.
+        self._encode_pages = (serde and kind != "BROADCAST"
+                              and isinstance(buffer, OutputBuffer)
+                              and encoded_exec())
+        self._encoders: dict[int, PageStreamEncoder] = {}
 
     def needs_input(self) -> bool:
         if (not self.blocking and hasattr(self.buffer, "has_capacity")
@@ -284,9 +295,14 @@ class PartitionedOutputSink(Operator):
         else:
             self.buffer.enqueue(partition, page, block=False)
 
-    def _page(self, batch: ColumnBatch):
+    def _page(self, batch: ColumnBatch, partition: Optional[int] = None):
         if self.serde:
-            return SerializedPage(serialize_batch(batch))
+            ctx = None
+            if self._encode_pages and partition is not None:
+                ctx = self._encoders.get(partition)
+                if ctx is None:
+                    ctx = self._encoders[partition] = PageStreamEncoder()
+            return SerializedPage(serialize_batch(batch, ctx=ctx))
         return batch
 
     def add_input(self, batch: ColumnBatch) -> None:
@@ -311,7 +327,7 @@ class PartitionedOutputSink(Operator):
                 if self.coalesce_rows:
                     self._buffer_sliver(p, sub)
                 else:
-                    self._enqueue(p, self._page(sub))
+                    self._enqueue(p, self._page(sub, p))
         elif self.kind == "BROADCAST" and n > 1:
             page = self._page(batch)
             for p in range(n):
@@ -319,10 +335,11 @@ class PartitionedOutputSink(Operator):
         elif self.kind == "ROUND_ROBIN" and n > 1:
             # batch-granular rotation (RandomExchanger / ArbitraryOutputBuffer
             # role: balance load without any key)
-            self._enqueue(self._rr % n, self._page(batch))
+            p = self._rr % n
+            self._enqueue(p, self._page(batch, p))
             self._rr += 1
         else:
-            self._enqueue(0, self._page(batch))
+            self._enqueue(0, self._page(batch, 0))
 
     def _buffer_sliver(self, p: int, sub: ColumnBatch) -> None:
         ent = self._pend.get(p)
@@ -336,7 +353,7 @@ class PartitionedOutputSink(Operator):
     def _flush_pending(self, p: int) -> None:
         ent = self._pend.pop(p, None)
         if ent is not None and ent[1]:
-            self._enqueue(p, self._page(ColumnBatch.concat(ent[1])))
+            self._enqueue(p, self._page(ColumnBatch.concat(ent[1]), p))
 
     def finish_input(self) -> None:
         super().finish_input()

@@ -602,11 +602,13 @@ class TaskServer:
             t.ready.set()
         try:
             if local is not None:
-                from ..exec.driver import collect_scan_stats
+                from ..exec.driver import (collect_encoding_stats,
+                                           collect_scan_stats)
 
                 ingest = collect_scan_stats(local.pipelines)
                 annotate_scan_span(sp, ingest)
                 tm.observe_scan(ingest)
+                tm.observe_encoding(collect_encoding_stats(local.pipelines))
         # tpulint: disable=error-taxonomy -- stats never fail a task
         except Exception:  # noqa: BLE001
             pass

@@ -14,7 +14,8 @@ from typing import Optional
 import numpy as np
 
 from .connectors.catalog import Catalog, default_catalog
-from .exec.driver import collect_scan_stats, run_pipelines
+from .exec.driver import (collect_encoding_stats, collect_scan_stats,
+                          run_pipelines)
 from .exec.local_planner import LocalPlanner
 from .exec.stats import QueryStats
 from .execution.tracing import annotate_scan_span, annotate_sync_span
@@ -687,6 +688,7 @@ class StandaloneQueryRunner:
 
         tm.observe_scan(ingest)
         tm.observe_sync(sync_delta)
+        tm.observe_encoding(collect_encoding_stats(local.pipelines))
         if ingest is not None:
             rt.add_input(rt.current_record(), ingest.scan_rows,
                          ingest.scan_bytes)

@@ -44,7 +44,28 @@ from .types import (
 )
 
 __all__ = ["Column", "ColumnBatch", "encode_strings", "unify_dictionaries",
-           "round_up_pow2", "pad_to_bucket"]
+           "round_up_pow2", "pad_to_bucket", "encoded_exec", "maybe_rle",
+           "set_materialize_hook"]
+
+
+def encoded_exec() -> bool:
+    """Compressed execution master switch (TRINO_TPU_ENCODED_EXEC):
+    ``auto``/``1`` let operators consume RLE/LAZY/dictionary encodings
+    directly; ``0`` is the bit-for-bit legacy expand-at-scan path."""
+    import os
+
+    return os.environ.get("TRINO_TPU_ENCODED_EXEC", "auto") != "0"
+
+
+# telemetry hook (set by telemetry/metrics.py): called with
+# (encoding, nbytes) whenever an encoded column materializes its flat
+# representation.  A plain module global so spi stays import-light.
+_MATERIALIZE_HOOK = None
+
+
+def set_materialize_hook(fn) -> None:
+    global _MATERIALIZE_HOOK
+    _MATERIALIZE_HOOK = fn
 
 
 def round_up_pow2(n: int, minimum: int = 8) -> int:
@@ -102,7 +123,30 @@ def encode_sorted_objects(values: Sequence, null_fill
     return codes, valid, _object_array(uniq)
 
 
-@dataclass
+# dictionary byte accounting: object-dtype dictionaries (strings, tuples)
+# report pointer bytes via .nbytes, so the real payload is summed once and
+# memoized by (id, len) — accounting, not an exact allocator figure
+_DICT_NBYTES_CACHE: dict = {}
+
+
+def _dictionary_nbytes(d) -> int:
+    if d is None:
+        return 0
+    if d.dtype != object:
+        return int(d.nbytes)
+    key = id(d)
+    hit = _DICT_NBYTES_CACHE.get(key)
+    if hit is not None and hit[0] == len(d):
+        return hit[1]
+    total = 0
+    for v in d:
+        total += len(str(v).encode("utf-8", "replace"))
+    if len(_DICT_NBYTES_CACHE) > 4096:
+        _DICT_NBYTES_CACHE.clear()
+    _DICT_NBYTES_CACHE[key] = (len(d), total)
+    return total
+
+
 class Column:
     """One column of a batch: fixed-width array + validity + dictionary.
 
@@ -110,28 +154,178 @@ class Column:
     the engine's hot path keeps columns on device between operators and only
     materializes to host at true boundaries (exchange serialization, client
     results, oracle diffs).  Mirrors how the reference keeps Pages inside the
-    JVM heap between compiled operators (operator/Driver.java:403-408)."""
+    JVM heap between compiled operators (operator/Driver.java:403-408).
 
-    type: Type
-    data: np.ndarray
-    valid: np.ndarray | None = None  # True = non-null; None = all valid
-    dictionary: np.ndarray | None = None  # sorted host-side values (strings)
+    The reference's sealed Block shapes are carried as an ``encoding`` tag
+    instead of subclasses (spi/block/Block.java:23):
+
+    - ``FLAT``  — dense array (ValueBlock)
+    - ``DICT``  — FLAT int32 codes + a host-side sorted ``dictionary``
+      (DictionaryBlock; mandatory for strings)
+    - ``RLE``   — ONE stored value + a run length (RunLengthEncodedBlock);
+      ``valid`` may still be a full-length mask (nulls inside the run)
+    - ``LAZY``  — a thunk producing ``(data, valid)`` on first touch
+      (LazyBlock); until touched the column costs no HBM and no PCIe
+
+    Touching ``.data``/``.valid`` on an encoded column materializes the
+    flat view exactly once (RLE materializes as a zero-copy broadcast
+    view).  Encoding-aware operators check ``.encoding`` first and never
+    touch the flat view on their fast paths."""
+
+    __slots__ = ("type", "dictionary", "_data", "_valid", "_length",
+                 "_enc", "_rle_value", "_thunk", "_nbytes_hint", "_derived")
+
+    def __init__(self, type: Type, data, valid=None, dictionary=None):
+        self.type = type
+        self.dictionary = dictionary
+        self._enc = "FLAT"
+        self._rle_value = None
+        self._thunk = None
+        self._nbytes_hint = 0
+        self._derived = False
+        self._data = data
+        self._length = int(data.shape[0])
+        self._valid = valid
+        self.__post_init__()
 
     def __post_init__(self):
         # normalizing all-valid masks to None requires a host sync for device
         # arrays — only do it for numpy
-        if isinstance(self.valid, np.ndarray) and self.valid.all():
-            self.valid = None
+        if isinstance(self._valid, np.ndarray) and self._valid.all():
+            self._valid = None
+
+    # -- encoded constructors ------------------------------------------------
+
+    @staticmethod
+    def rle(type_: Type, value, length: int, valid=None,
+            dictionary=None) -> "Column":
+        """Run-length column: one stored value repeated ``length`` times.
+        ``value`` is the storage-dtype scalar (the int32 code for
+        dictionary columns); ``valid`` may be a full-length mask so a run
+        can contain NULLs without breaking the encoding."""
+        c = Column.__new__(Column)
+        c.type = type_
+        c.dictionary = dictionary
+        c._enc = "RLE"
+        dtype = np.int32 if dictionary is not None else type_.storage_dtype
+        c._rle_value = np.asarray(value, dtype=dtype)
+        c._thunk = None
+        c._nbytes_hint = 0
+        c._derived = False
+        c._data = None
+        c._length = int(length)
+        c._valid = valid
+        c.__post_init__()
+        return c
+
+    @staticmethod
+    def lazy(type_: Type, length: int, thunk, dictionary=None,
+             nbytes_hint: int = 0, derived: bool = False) -> "Column":
+        """Deferred column: ``thunk()`` returns ``(data, valid)`` and runs
+        at most once, on first ``.data``/``.valid`` touch.  ``nbytes_hint``
+        feeds byte accounting while unmaterialized (e.g. the host bytes the
+        thunk would stage).  ``derived`` marks a wrapper over another lazy
+        column (pad/slice composition) so the materialize hook fires once
+        per logical column, at the innermost thunk."""
+        c = Column.__new__(Column)
+        c.type = type_
+        c.dictionary = dictionary
+        c._enc = "LAZY"
+        c._rle_value = None
+        c._thunk = thunk
+        c._nbytes_hint = int(nbytes_hint)
+        c._derived = bool(derived)
+        c._data = None
+        c._length = int(length)
+        c._valid = None
+        return c
+
+    # -- encoding accessors --------------------------------------------------
+
+    @property
+    def encoding(self) -> str:
+        """``FLAT | DICT | RLE | LAZY`` — DICT is a flat code array with a
+        dictionary attached (codes ARE the flat representation here)."""
+        if self._enc == "FLAT" and self.dictionary is not None:
+            return "DICT"
+        return self._enc
+
+    @property
+    def rle_value(self):
+        """The RLE run's stored scalar (storage dtype; code if DICT)."""
+        assert self._enc == "RLE"
+        return self._rle_value
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._data is not None or self._enc == "RLE"
+
+    def _materialize(self) -> None:
+        if self._data is not None:
+            return
+        if self._enc == "RLE":
+            # zero-copy: a readonly broadcast view over the single value
+            self._data = np.broadcast_to(self._rle_value, (self._length,))
+            return
+        hook = _MATERIALIZE_HOOK
+        thunk, self._thunk = self._thunk, None
+        data, valid = thunk()
+        assert int(data.shape[0]) == self._length, "lazy thunk length"
+        self._data = data
+        if self._valid is None:
+            self._valid = valid
+            self.__post_init__()
+        self._enc = "FLAT"
+        if hook is not None and not self._derived:
+            hook("LAZY", self._nbytes_hint or int(data.nbytes))
+
+    @property
+    def data(self):
+        if self._data is None:
+            self._materialize()
+        return self._data
+
+    @property
+    def valid(self):
+        if self._data is None and self._enc == "LAZY":
+            self._materialize()
+        return self._valid
 
     def __len__(self) -> int:
-        return int(self.data.shape[0])
+        return self._length
+
+    def __repr__(self) -> str:  # debugging aid (dataclass repr equivalent)
+        return (f"Column(type={self.type}, encoding={self.encoding}, "
+                f"len={self._length})")
+
+    def __reduce__(self):
+        # pickling (task descriptors) materializes: thunks don't pickle
+        return (Column, (self.type, np.asarray(self.data), self._valid,
+                         self.dictionary))
 
     @property
     def nbytes(self) -> int:
-        n = int(self.data.nbytes)
-        if self.valid is not None:
-            n += int(self.valid.nbytes)
-        return n
+        if self._enc == "RLE":
+            n = int(self._rle_value.nbytes)
+        elif self._data is None:
+            n = self._nbytes_hint
+        else:
+            n = int(self._data.nbytes)
+        if self._valid is not None:
+            n += int(self._valid.nbytes)
+        return n + _dictionary_nbytes(self.dictionary)
+
+    @property
+    def flat_nbytes(self) -> int:
+        """Bytes of the EXPANDED flat representation (what legacy execution
+        would carry) — the baseline for bytes-saved accounting."""
+        itemsize = np.dtype(
+            np.int32 if self.dictionary is not None
+            else self.type.storage_dtype).itemsize
+        n = self._length * itemsize
+        if self._valid is not None:
+            n += self._length
+        return n + _dictionary_nbytes(self.dictionary)
 
     def valid_mask(self) -> np.ndarray:
         if self.valid is None:
@@ -179,7 +373,22 @@ class Column:
         data = np.asarray(filled, dtype=type_.storage_dtype)
         return Column(type_, data, valid)
 
+    def _empty_flat(self) -> "Column":
+        """Zero-row flat column — lets an empty selection over an
+        unmaterialized LAZY column skip the thunk entirely."""
+        dtype = (np.int32 if self.dictionary is not None
+                 else self.type.storage_dtype)
+        return Column(self.type, np.empty(0, dtype), None, self.dictionary)
+
     def take(self, indices: np.ndarray) -> "Column":
+        if self._enc == "RLE":
+            # a gather over a constant run is still a constant run
+            valid = None if self._valid is None else self._valid[indices]
+            return Column.rle(self.type, self._rle_value,
+                              int(indices.shape[0]), valid, self.dictionary)
+        if (self._enc == "LAZY" and self._data is None
+                and int(np.asarray(indices).shape[0]) == 0):
+            return self._empty_flat()
         # works for numpy and jax alike (jax arrays gather on device)
         valid = None if self.valid is None else self.valid[indices]
         return Column(self.type, self.data[indices], valid, self.dictionary)
@@ -187,8 +396,29 @@ class Column:
     def filter(self, mask: np.ndarray) -> "Column":
         # boolean-mask compaction is inherently dynamic-shape: force host
         mask = np.asarray(mask)
+        if self._enc == "RLE":
+            valid = (None if self._valid is None
+                     else np.asarray(self._valid)[mask])
+            return Column.rle(self.type, self._rle_value,
+                              int(mask.sum()), valid, self.dictionary)
+        if (self._enc == "LAZY" and self._data is None
+                and not mask.any()):
+            return self._empty_flat()
         valid = None if self.valid is None else np.asarray(self.valid)[mask]
         return Column(self.type, np.asarray(self.data)[mask], valid, self.dictionary)
+
+    def slice_rows(self, start: int, stop: int) -> "Column":
+        """Row-range slice with encoding propagation (host path)."""
+        if self._enc == "RLE":
+            stop = min(stop, self._length)
+            valid = (None if self._valid is None
+                     else np.asarray(self._valid)[start:stop])
+            return Column.rle(self.type, self._rle_value,
+                              max(0, stop - start), valid, self.dictionary)
+        return Column(self.type, np.asarray(self.data)[start:stop],
+                      None if self.valid is None
+                      else np.asarray(self.valid)[start:stop],
+                      self.dictionary)
 
     def to_pylist(self) -> list:
         """Decode to python values (None for NULL) — used by clients/oracle."""
@@ -379,6 +609,8 @@ class ColumnBatch:
         round trip per batch instead of one per column."""
         pending = []
         for c in self.columns:
+            if c.encoding == "LAZY":
+                continue  # untouched: materializing would defeat laziness
             if not isinstance(c.data, np.ndarray):
                 pending.append(c.data)
             if c.valid is not None and not isinstance(c.valid, np.ndarray):
@@ -392,11 +624,18 @@ class ColumnBatch:
         fetched = iter(jax.device_get(pending))
         cols = []
         for c in self.columns:
+            if c.encoding == "LAZY":
+                cols.append(c)
+                continue
             d = c.data if isinstance(c.data, np.ndarray) else next(fetched)
             v = c.valid
             if v is not None and not isinstance(v, np.ndarray):
                 v = next(fetched)
-            cols.append(Column(c.type, d, v, c.dictionary))
+            if c.encoding == "RLE":
+                cols.append(Column.rle(c.type, c.rle_value, len(c), v,
+                                       c.dictionary))
+            else:
+                cols.append(Column(c.type, d, v, c.dictionary))
         live = self.live
         if live is not None and not isinstance(live, np.ndarray):
             live = next(fetched)
@@ -448,10 +687,7 @@ class ColumnBatch:
         assert self.live is None, "slice() on a masked batch (compact first)"
         return ColumnBatch(
             self.names,
-            [Column(c.type, np.asarray(c.data)[start:stop],
-                    None if c.valid is None else np.asarray(c.valid)[start:stop],
-                    c.dictionary)
-             for c in self.columns],
+            [c.slice_rows(start, stop) for c in self.columns],
         )
 
     @staticmethod
@@ -467,6 +703,10 @@ class ColumnBatch:
         out_cols = []
         for i in range(len(names)):
             cols = [b.columns[i] for b in batches]
+            rle = _concat_rle(cols)
+            if rle is not None:
+                out_cols.append(rle)
+                continue
             if cols[0].type.is_dictionary_encoded:
                 cols = unify_dictionaries(cols)
             data = np.concatenate([np.asarray(c.data) for c in cols])
@@ -487,6 +727,53 @@ class ColumnBatch:
         return ColumnBatch(list(names), self.columns, self.live)
 
 
+def _same_dictionary(a, b) -> bool:
+    if a is None or b is None:
+        return a is b
+    return a is b or (a.shape == b.shape and (a == b).all())
+
+
+def _concat_rle(cols: Sequence[Column]):
+    """One RLE column covering a concatenation of same-value runs, or None
+    when the inputs aren't a single mergeable run."""
+    if not all(c.encoding == "RLE" for c in cols):
+        return None
+    first = cols[0]
+    for c in cols[1:]:
+        if (c.rle_value != first.rle_value
+                or not _same_dictionary(c.dictionary, first.dictionary)):
+            return None
+    total = sum(len(c) for c in cols)
+    if all(c.valid is None for c in cols):
+        valid = None
+    else:
+        valid = np.concatenate([c.valid_mask() for c in cols])
+    return Column.rle(first.type, first.rle_value, total, valid,
+                      first.dictionary)
+
+
+# RLE page-build detection floor: below this a run saves nothing worth the
+# check; the two-element probe keeps the reject path O(1)
+RLE_DETECT_MIN_ROWS = 64
+
+
+def maybe_rle(col: Column) -> Column:
+    """Cheap constant-run detection at page build: a dense host column whose
+    every element equals its first collapses to RLE.  O(1) reject via a
+    first/last probe before the full equality scan; non-FLAT/DICT and
+    device columns pass through untouched."""
+    if col.encoding not in ("FLAT", "DICT") or len(col) < RLE_DETECT_MIN_ROWS:
+        return col
+    data = col._data
+    if not isinstance(data, np.ndarray) or data.dtype == object:
+        return col
+    if data[0] != data[-1] or not (data == data[0]).all():
+        return col
+    if col.valid is not None and not isinstance(col.valid, np.ndarray):
+        return col
+    return Column.rle(col.type, data[0], len(col), col.valid, col.dictionary)
+
+
 def pad_to_bucket(batch: ColumnBatch) -> ColumnBatch:
     """Pad a dense batch to its power-of-two row bucket, marking the padding
     dead in ``live``.  A batch that already carries a ``live`` mask is
@@ -500,12 +787,49 @@ def pad_to_bucket(batch: ColumnBatch) -> ColumnBatch:
     if cap == n or n == 0:
         return batch
     pad = cap - n
-    on_device = any(not isinstance(c.data, np.ndarray) for c in batch.columns)
+    on_device = any(c.encoding not in ("RLE", "LAZY")
+                    and not isinstance(c.data, np.ndarray)
+                    for c in batch.columns)
+
+    def _pad_encoded(c: Column):
+        """RLE extends its run over the dead pad rows; LAZY composes a
+        padding thunk — neither expands."""
+        if c.encoding == "RLE":
+            valid = c.valid
+            if valid is not None:
+                if isinstance(valid, np.ndarray):
+                    valid = np.concatenate(
+                        [valid, np.zeros(pad, np.bool_)])
+                else:
+                    import jax.numpy as jnp
+
+                    valid = jnp.concatenate(
+                        [valid, jnp.zeros(pad, jnp.bool_)])
+            return Column.rle(c.type, c.rle_value, cap, valid, c.dictionary)
+        if c.encoding == "LAZY":
+            def thunk(c=c):
+                data = np.concatenate(
+                    [np.asarray(c.data),
+                     np.zeros(pad, np.asarray(c.data).dtype)])
+                valid = None
+                if c.valid is not None:
+                    valid = np.concatenate(
+                        [np.asarray(c.valid), np.zeros(pad, np.bool_)])
+                return data, valid
+
+            return Column.lazy(c.type, cap, thunk, c.dictionary,
+                               nbytes_hint=c.nbytes, derived=True)
+        return None
+
     if on_device:
         import jax.numpy as jnp
 
         cols = []
         for c in batch.columns:
+            enc = _pad_encoded(c)
+            if enc is not None:
+                cols.append(enc)
+                continue
             data = jnp.concatenate(
                 [jnp.asarray(c.data), jnp.zeros(pad, jnp.asarray(c.data).dtype)])
             valid = None
@@ -518,6 +842,10 @@ def pad_to_bucket(batch: ColumnBatch) -> ColumnBatch:
         return ColumnBatch(batch.names, cols, live)
     cols = []
     for c in batch.columns:
+        enc = _pad_encoded(c)
+        if enc is not None:
+            cols.append(enc)
+            continue
         data = np.asarray(c.data)
         data = np.concatenate([data, np.zeros(pad, data.dtype)])
         valid = None

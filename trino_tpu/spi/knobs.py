@@ -80,6 +80,11 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_DRAIN_TIMEOUT_S", "float", "300",
          "Graceful-drain budget: a SHUTTING_DOWN worker abandons "
          "unfinished tasks and exits with code 9 past this."),
+    Knob("TRINO_TPU_ENCODED_EXEC", "enum", "auto",
+         "Compressed execution: operators consume dictionary codes, RLE "
+         "runs, and lazy columns directly (decode at most once per "
+         "query); 0 is bit-for-bit legacy expand-at-scan.",
+         choices=("auto", "1", "0")),
     Knob("TRINO_TPU_EXCHANGE_STALL_S", "float", "1800",
          "Exchange take() stall watchdog: a source that produces nothing "
          "for this long fails the take with PAGE_TRANSPORT_TIMEOUT."),

@@ -35,7 +35,7 @@ from typing import Optional
 __all__ = [
     "Counter", "Gauge", "Distribution", "MetricsRegistry", "REGISTRY",
     "observe_scan", "observe_sync", "observe_resilience", "observe_fused",
-    "observe_exchange", "observe_adaptive",
+    "observe_exchange", "observe_adaptive", "observe_encoding",
     "update_device_memory_watermark",
 ]
 
@@ -583,6 +583,63 @@ ADAPTIVE_SKEW_IMBALANCE = REGISTRY.gauge(
     "divided by after; the load-balance win a parallel host realises")
 
 
+# compressed execution (spi/batch.py encodings + encoding-aware operators):
+# dictionary / RLE / lazy columns flowing through the pipeline instead of
+# flat dense arrays, gated by TRINO_TPU_ENCODED_EXEC
+ENCODING_RLE_BATCHES = REGISTRY.counter(
+    "trino_encoding_rle_batches_total",
+    "batches carrying at least one run-length-encoded column")
+ENCODING_LAZY_COLUMNS = REGISTRY.counter(
+    "trino_encoding_lazy_columns_total",
+    "lazy (deferred-materialization) columns created by staging")
+ENCODING_LAZY_MATERIALIZED = REGISTRY.counter(
+    "trino_encoding_lazy_materialized_total",
+    "lazy columns whose thunk actually ran (first touch)")
+ENCODING_BYTES_SAVED = REGISTRY.counter(
+    "trino_encoding_bytes_saved_total",
+    "bytes not staged or shipped because a column stayed encoded "
+    "(flat-equivalent size minus encoded size)")
+ENCODING_LAZY_SKIPPED_BYTES = REGISTRY.counter(
+    "trino_encoding_lazy_skipped_bytes_total",
+    "payload bytes whose transfer was deferred by lazy staging (subtract "
+    "trino_encoding_lazy_materialized_bytes_total for bytes that truly "
+    "never moved)")
+ENCODING_LAZY_MATERIALIZED_BYTES = REGISTRY.counter(
+    "trino_encoding_lazy_materialized_bytes_total",
+    "deferred payload bytes that DID move in the end because the lazy "
+    "column's thunk ran (first touch)")
+ENCODING_DICT_SIDECAR_SENT = REGISTRY.counter(
+    "trino_encoding_dict_sidecar_sent_total",
+    "dictionary sidecars shipped on a serde v2 stream (once per "
+    "(stream, column) — not per page)")
+ENCODING_DICT_SIDECAR_REUSED = REGISTRY.counter(
+    "trino_encoding_dict_sidecar_reused_total",
+    "pages that referenced an already-shipped dictionary sidecar by id "
+    "instead of re-sending values")
+ENCODING_EXCHANGE_CODE_PAGES = REGISTRY.counter(
+    "trino_encoding_exchange_code_pages_total",
+    "exchange pages whose dictionary codes crossed the shuffle without "
+    "a decode (repartition serde v2 or collective all_to_all)")
+ENCODING_RLE_AGG_ROWS = REGISTRY.counter(
+    "trino_encoding_rle_agg_rows_total",
+    "input rows aggregated arithmetically from RLE runs (value * "
+    "run_count) without expansion")
+
+# Install the spi/batch.py materialization hook so every lazy-thunk first
+# touch is visible engine-wide.  spi imports nothing from telemetry, so
+# this direction is cycle-free.
+from ..spi import batch as _spi_batch  # noqa: E402
+
+
+def _on_materialize(encoding: str, nbytes: int) -> None:
+    if encoding == "LAZY":
+        ENCODING_LAZY_MATERIALIZED.inc()
+        ENCODING_LAZY_MATERIALIZED_BYTES.inc(nbytes)
+
+
+_spi_batch.set_materialize_hook(_on_materialize)
+
+
 # ------------------------------------------------------------ observe hooks
 def resource_group_gauges(path: str):
     """(running, queued) gauge pair for one resource group.  Group trees
@@ -674,6 +731,20 @@ def observe_adaptive(st) -> None:
     if st is None or not st.any:
         return
     ADAPTIVE_STAGE_ACTIVATIONS.inc(st.activations)
+
+
+def observe_encoding(enc) -> None:
+    """Fold an EncodingStats roll-up (exec/stats.py).  ``lazy_materialized``
+    is NOT folded: the spi/batch.py materialize hook records it at thunk
+    time; the exchange/sidecar counters are likewise recorded at the serde
+    boundary (execution/serde.py, execution/task.py)."""
+    if enc is None or not enc.any:
+        return
+    ENCODING_RLE_BATCHES.inc(enc.rle_batches)
+    ENCODING_LAZY_COLUMNS.inc(enc.lazy_columns)
+    ENCODING_BYTES_SAVED.inc(enc.bytes_saved)
+    ENCODING_LAZY_SKIPPED_BYTES.inc(enc.lazy_skipped_bytes)
+    ENCODING_RLE_AGG_ROWS.inc(enc.rle_agg_rows)
 
 
 def update_device_memory_watermark() -> Optional[int]:

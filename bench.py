@@ -1192,6 +1192,7 @@ def _count_stage_dispatches(runner, sql: str) -> tuple[dict[str, int], int]:
 
     import trino_tpu.exec.operators as O
     import trino_tpu.execution.collective_exchange as CE
+    import trino_tpu.execution.plan_compiler as PC
     import trino_tpu.execution.stage_compiler as SC
 
     global _REGION_TLS
@@ -1209,6 +1210,10 @@ def _count_stage_dispatches(runner, sql: str) -> tuple[dict[str, int], int]:
         (SC.FusedStageSinkOperator, "add_input", "fused_sink", True),
         (SC.FusedStageSinkOperator, "finish_input", None, True),
         (SC.FusedStageSourceOperator, "get_output", "fused_source", True),
+        (PC.ResidentPlanSinkOperator, "add_input", "resident_sink", True),
+        (PC.ResidentPlanSinkOperator, "finish_input", None, True),
+        (PC.ResidentBuildSinkOperator, "add_input", "resident_build", True),
+        (PC.ResidentBuildSinkOperator, "finish_input", None, True),
     ]
     saved = []
     for cls, meth, label, arm in targets:
@@ -1240,46 +1245,69 @@ def _count_stage_dispatches(runner, sql: str) -> tuple[dict[str, int], int]:
 
 
 def run_fused_bench() -> None:
-    """`bench.py --fused`: whole-stage compilation vs the legacy per-operator
-    + collective-exchange path (TRINO_TPU_FUSED_STAGE=auto vs 0) on the
-    8-device CPU mesh.  Per query: median wall, input rows/s, accumulate
-    compile count + shape-bucket cache hit rate, and the per-batch Python
-    dispatch counts of the stage region; results land in BENCH_r06.json.
-    Env knobs: BENCH_FUSED_SF (default 0.1), BENCH_FUSED_WORKERS (default 4),
-    BENCH_ITERS (default 3)."""
+    """`bench.py --fused`: whole-query resident compilation vs whole-stage
+    compilation vs the legacy per-operator + collective-exchange path
+    (TRINO_TPU_RESIDENT_PLAN / TRINO_TPU_FUSED_STAGE) on the 8-device CPU
+    mesh, plus a mesh-width scaling curve (1/2/4/8 host-platform devices)
+    for the fully-resident q3.  Per query: median wall, input rows/s,
+    program compile count + shape-bucket cache hit rate, and the per-batch
+    Python dispatch counts of the stage region; results land in
+    BENCH_r17.json.  Env knobs: BENCH_FUSED_SF (default 0.1),
+    BENCH_FUSED_WORKERS (default 4), BENCH_ITERS (default 3)."""
     if os.environ.get("BENCH_FUSED_INNER") != "1":
         # the mesh needs --xla_force_host_platform_device_count before jax
         # imports; re-exec in a subprocess (same pattern as --baseline)
-        xla = (os.environ.get("XLA_FLAGS", "")
-               + " --xla_force_host_platform_device_count=8").strip()
-        env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=xla,
-                   BENCH_FUSED_INNER="1")
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--fused"],
-            env=env, capture_output=True, text=True, timeout=7200)
-        if proc.stderr:
-            print(proc.stderr[-4000:], file=sys.stderr)
-        if proc.returncode != 0:
-            raise SystemExit("fused bench inner run failed")
-        line = proc.stdout.strip().splitlines()[-1]
+        base_xla = os.environ.get("XLA_FLAGS", "")
+
+        def inner(n_dev: int, extra_env: dict) -> dict:
+            xla = (base_xla
+                   + f" --xla_force_host_platform_device_count={n_dev}"
+                   ).strip()
+            env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=xla,
+                       BENCH_FUSED_INNER="1", **extra_env)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--fused"],
+                env=env, capture_output=True, text=True, timeout=7200)
+            if proc.stderr:
+                print(proc.stderr[-4000:], file=sys.stderr)
+            if proc.returncode != 0:
+                raise SystemExit("fused bench inner run failed")
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        data = inner(8, {})
+        # mesh-width scaling: one subprocess per width so the forced
+        # host-platform device count (and the mesh it bounds) matches
+        data["mesh_scaling"] = {
+            str(w): inner(w, {"BENCH_FUSED_SCALE_WIDTH": str(w),
+                              "BENCH_FUSED_WORKERS": str(w)})
+            for w in (1, 2, 4, 8)}
+        line = json.dumps(data)
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r06.json")
+                            "BENCH_r17.json")
         with open(path, "w") as f:
             f.write(line + "\n")
         print(line)
         return
 
+    if os.environ.get("BENCH_FUSED_SCALE_WIDTH"):
+        _run_fused_scale_leg()
+        return
+
     sf = float(os.environ.get("BENCH_FUSED_SF", "0.1"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     workers = int(os.environ.get("BENCH_FUSED_WORKERS", "4"))
+    # the A/B re-executes identical statements: a served cached result
+    # would measure the PR 12 result cache, not the execution legs
+    os.environ["TRINO_TPU_RESULT_CACHE"] = "0"
     _enable_compile_cache()
     import jax
 
     _install_jit_call_counter()  # must precede the trino_tpu imports
 
     from trino_tpu.connectors.catalog import default_catalog
-    from trino_tpu.exec.stats import FusedStageStats
+    from trino_tpu.exec.stats import FusedStageStats, ResidentPlanStats
     from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.execution.plan_compiler import ResidentPlanExec
     from trino_tpu.runner import Session
 
     # tpch connector directly (NOT the consolidated memory tables): the
@@ -1291,23 +1319,26 @@ def run_fused_bench() -> None:
 
     import trino_tpu.exec.operators as O
 
-    # three legs: fused, the default legacy path (which BUFFERS a task's
-    # whole input and aggregates once — per-TASK amortization the CPU mesh
-    # can afford), and the legacy path with a memory-bounded flush window
-    # sized to the batch bucket (the streaming regime a device-resident
-    # stage actually runs in: HBM cannot buffer a task's whole input, so
-    # PARTIAL flushes per window — this is the per-batch dispatch regime
-    # whole-stage compilation eliminates)
+    # four legs: resident (whole-QUERY compilation — joins inlined), fused
+    # (PR 6 whole-stage seam only), the default legacy path (which BUFFERS
+    # a task's whole input and aggregates once — per-TASK amortization the
+    # CPU mesh can afford), and the legacy path with a memory-bounded flush
+    # window sized to the batch bucket (the streaming regime a device-
+    # resident stage actually runs in: HBM cannot buffer a task's whole
+    # input, so PARTIAL flushes per window — this is the per-batch dispatch
+    # regime whole-stage/whole-query compilation eliminates)
     stream_flush = 1 << 15
-    modes = (("fused", "auto", None),
-             ("legacy", "0", None),
-             ("legacy_streaming", "0", stream_flush))
+    modes = (("resident", "auto", "auto", None),
+             ("fused", "auto", "0", None),
+             ("legacy", "0", "0", None),
+             ("legacy_streaming", "0", "0", stream_flush))
     queries: dict[str, dict] = {}
     for name, sql in QUERIES.items():
         rows, _ = _scan_stats(runner, sql)
         per_mode: dict[str, dict] = {}
-        for mode, env_val, flush_rows in modes:
+        for mode, env_val, resident_val, flush_rows in modes:
             os.environ["TRINO_TPU_FUSED_STAGE"] = env_val
+            os.environ["TRINO_TPU_RESIDENT_PLAN"] = resident_val
             default_flush = O.HashAggregationOperator.FLUSH_ROWS
             if flush_rows is not None:
                 O.HashAggregationOperator.FLUSH_ROWS = flush_rows
@@ -1336,6 +1367,24 @@ def run_fused_bench() -> None:
             }
             if flush_rows is not None:
                 entry["flush_rows"] = flush_rows
+            if mode == "resident":
+                rroll = ResidentPlanStats()
+                for ex in runner._resident_edges.values():
+                    if isinstance(ex, ResidentPlanExec):
+                        rroll.merge(ex.rstats)
+                entry["resident_plans"] = rroll.plans
+                if rroll.plans:
+                    # the whole point: the entire join tree + agg is ONE
+                    # jitted dispatch per probe batch
+                    entry.update({
+                        "batches": rroll.batches,
+                        "jit_calls": rroll.jit_calls,
+                        "seams_fused": rroll.seams,
+                        "seam_merges": rroll.merges,
+                        "code_seam_columns": rroll.code_seam_columns,
+                        "launches_per_batch": round(
+                            rroll.launches_per_batch, 2),
+                    })
             if mode == "fused":
                 assert runner._fused_edges, \
                     f"{name}: expected a fused stage seam"
@@ -1362,6 +1411,7 @@ def run_fused_bench() -> None:
                   f"{entry['stage_dispatches']} stage dispatches",
                   file=sys.stderr)
         os.environ.pop("TRINO_TPU_FUSED_STAGE", None)
+        os.environ.pop("TRINO_TPU_RESIDENT_PLAN", None)
         fused = per_mode["fused"]
         batches = max(fused.get("batches", 1), 1)
         # per-batch normalization over the input batches the stage absorbed
@@ -1370,25 +1420,88 @@ def run_fused_bench() -> None:
         # legacy chain's filter/project jit call EXCLUDED (it runs inside
         # the fused program, which is fully counted) — both choices bias
         # against the fused path, so the ratios are underestimates.
-        for m in ("fused", "legacy", "legacy_streaming"):
+        res_batches = max(per_mode["resident"].get("batches", batches), 1)
+        for m, b in (("resident", res_batches), ("fused", batches),
+                     ("legacy", batches), ("legacy_streaming", batches)):
             per_mode[m]["region_dispatches_per_batch"] = round(
-                per_mode[m]["region_device_dispatches"] / batches, 2)
+                per_mode[m]["region_device_dispatches"] / b, 2)
         fused_r = max(fused["region_device_dispatches"], 1)
         per_mode["dispatch_reduction"] = round(
             per_mode["legacy_streaming"]["region_device_dispatches"]
             / fused_r, 2)
         per_mode["dispatch_reduction_vs_buffered"] = round(
             per_mode["legacy"]["region_device_dispatches"] / fused_r, 2)
+        if per_mode["resident"].get("resident_plans"):
+            # the resident region ALSO covers the inlined joins, which the
+            # other legs run un-armed on the operator pipeline — the ratio
+            # still undercounts the resident win
+            res_r = max(per_mode["resident"]["region_device_dispatches"], 1)
+            per_mode["resident_dispatch_reduction"] = round(
+                per_mode["legacy_streaming"]["region_device_dispatches"]
+                / res_r, 2)
         queries[name] = per_mode
 
     print(json.dumps({
-        "metric": f"fused_stage_sf{sf:g}",
+        "metric": f"resident_plan_sf{sf:g}",
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
         "workers": workers,
         "iters": iters,
         "queries": queries,
     }))
+
+
+def _run_fused_scale_leg() -> None:
+    """One mesh-width point of the scaling curve: q3 fully resident on a
+    BENCH_FUSED_SCALE_WIDTH-task mesh (the forced host-platform device
+    count matches, so the mesh is exactly that wide).  Width 1 has no
+    collectives — the resident plan is ineligible there and the point
+    records the serial baseline."""
+    width = int(os.environ["BENCH_FUSED_SCALE_WIDTH"])
+    sf = float(os.environ.get("BENCH_FUSED_SF", "0.1"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    os.environ["TRINO_TPU_RESULT_CACHE"] = "0"
+    _enable_compile_cache()
+    import jax
+
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.exec.stats import ResidentPlanStats
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.execution.plan_compiler import ResidentPlanExec
+    from trino_tpu.runner import Session
+
+    os.environ["TRINO_TPU_RESIDENT_PLAN"] = "auto"
+    catalog = default_catalog(scale_factor=sf)
+    runner = DistributedQueryRunner(
+        catalog, worker_count=width, session=Session(node_count=width))
+    sql = QUERIES["q3"]
+    rows, _ = _scan_stats(runner, sql)
+    runner.execute(sql)  # warmup: compile every program for this width
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        runner.execute(sql)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    wall = samples[len(samples) // 2]
+    roll = ResidentPlanStats()
+    for ex in runner._resident_edges.values():
+        if isinstance(ex, ResidentPlanExec):
+            roll.merge(ex.rstats)
+    out = {
+        "devices": len(jax.devices()),
+        "workers": width,
+        "wall_ms": round(wall * 1e3, 1),
+        "input_rows_per_sec": round(rows / wall),
+        "resident_plans": roll.plans,
+    }
+    if roll.plans:
+        out.update({
+            "batches": roll.batches,
+            "jit_calls": roll.jit_calls,
+            "launches_per_batch": round(roll.launches_per_batch, 2),
+        })
+    print(json.dumps(out))
 
 
 def run_profile_bench() -> None:

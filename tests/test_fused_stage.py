@@ -31,6 +31,9 @@ def _no_result_cache(monkeypatch):
     # deltas) on repeated statements — a served cached result would skip
     # the very path under test
     monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
+    # whole-QUERY resident compilation would absorb the q3 seam this file
+    # exercises in isolation (tests/test_resident_plan.py covers it)
+    monkeypatch.setenv("TRINO_TPU_RESIDENT_PLAN", "0")
 
 AGG_SQL = """
 select l_returnflag, l_linestatus,

@@ -55,9 +55,11 @@ PATTERNS: list = [
 # sync-free bodies, so raw fetches there are as load-bearing a bug as in exec
 SCAN_DIRS = ("trino_tpu/exec", "trino_tpu/ops", "trino_tpu/parallel")
 # the fused-stage path promises ZERO host syncs between input deposit and
-# output take, and the collective exchange is its legacy twin
+# output take, the collective exchange is its legacy twin, and the
+# resident-plan driver loop extends the same promise over whole subtrees
 SCAN_FILES = ("trino_tpu/execution/stage_compiler.py",
-              "trino_tpu/execution/collective_exchange.py")
+              "trino_tpu/execution/collective_exchange.py",
+              "trino_tpu/execution/plan_compiler.py")
 EXEMPT_FILES = ("syncguard.py",)  # the sanctioned wrapper itself
 PRAGMA = "sync-ok"
 

@@ -321,7 +321,7 @@ def warm_at_boot(limit: int = 64) -> int:
     # the decorated sites only exist once their modules are imported
     for mod in ("exec.kernels", "exec.join_exec", "exec.window_kernels",
                 "ops.pallas_kernels", "execution.stage_compiler",
-                "execution.collective_exchange"):
+                "execution.collective_exchange", "execution.plan_compiler"):
         try:
             __import__(f"{__package__.rsplit('.', 1)[0]}.{mod}",
                        fromlist=["_"])

@@ -207,6 +207,63 @@ class FusedStageStats:
 
 
 @dataclass
+class ResidentPlanStats:
+    """Counters for whole-query GSPMD compilation (execution/plan_compiler.py):
+    maximal TPU-resident plans compiled as ONE program per batch, interior
+    seams (broadcast builds + the agg repartition) fused in-program, and the
+    legacy re-runs taken when a plan can't hold (duplicate build keys, state
+    overflow).  One instance per ResidentPlanExec; ``merge`` folds them into
+    the query-level roll-up."""
+
+    plans: int = 0             # resident plans that executed
+    programs: int = 0          # distinct (program, bucket) traces compiled
+    seams: int = 0             # interior exchange edges fused in-program
+    batches: int = 0           # probe batches absorbed
+    jit_calls: int = 0         # whole-plan program dispatches (one per batch)
+    cache_hits: int = 0        # dispatches served by an existing trace
+    input_rows: int = 0        # physical probe rows (padded slots included)
+    merges: int = 0            # terminal seam merges (one per plan)
+    code_seam_columns: int = 0  # dict-code lanes crossing an interior seam
+    fallbacks: int = 0         # overflow/dup-key -> legacy re-runs
+    fallback_reasons: list[str] = field(default_factory=list)
+
+    def merge(self, other: "ResidentPlanStats") -> None:
+        self.plans += other.plans
+        self.programs += other.programs
+        self.seams += other.seams
+        self.batches += other.batches
+        self.jit_calls += other.jit_calls
+        self.cache_hits += other.cache_hits
+        self.input_rows += other.input_rows
+        self.merges += other.merges
+        self.code_seam_columns += other.code_seam_columns
+        self.fallbacks += other.fallbacks
+        self.fallback_reasons.extend(other.fallback_reasons)
+
+    @property
+    def launches_per_batch(self) -> float:
+        return self.jit_calls / self.batches if self.batches else 0.0
+
+    @property
+    def any(self) -> bool:
+        return any((self.plans, self.batches, self.jit_calls,
+                    self.merges, self.fallbacks))
+
+    def text(self) -> str:
+        why = f" ({', '.join(self.fallback_reasons)})" \
+            if self.fallback_reasons else ""
+        return (
+            f"resident: {self.plans} plans ({self.seams} seams fused), "
+            f"{self.batches} batches ({self.input_rows} rows) in "
+            f"{self.jit_calls} jit calls "
+            f"({self.launches_per_batch:.2f} launches/batch), "
+            f"{self.programs} programs / {self.cache_hits} cache hits, "
+            f"{self.code_seam_columns} code-seam columns, "
+            f"{self.merges} merges, {self.fallbacks} fallbacks{why}"
+        )
+
+
+@dataclass
 class AdaptiveStats:
     """Counters + decision tags for the adaptive execution plane
     (execution/adaptive.py): phased stage activations and the join-
@@ -328,6 +385,7 @@ class QueryStats:
     sync: "object | None" = None  # syncguard.SyncStats delta for this query
     resilience: ResilienceStats | None = None  # retry/heartbeat delta
     fused: FusedStageStats | None = None  # whole-stage compilation counters
+    resident: ResidentPlanStats | None = None  # whole-plan compilation counters
     adaptive: AdaptiveStats | None = None  # adaptive-execution decisions
     encoding: EncodingStats | None = None  # compressed-execution counters
 
@@ -345,6 +403,11 @@ class QueryStats:
         if self.fused is None:
             self.fused = FusedStageStats()
         self.fused.merge(fused)
+
+    def merge_resident(self, resident: ResidentPlanStats) -> None:
+        if self.resident is None:
+            self.resident = ResidentPlanStats()
+        self.resident.merge(resident)
 
     def merge_sync(self, sync) -> None:
         if self.sync is None:
@@ -365,6 +428,8 @@ class QueryStats:
             lines.append("  " + self.resilience.text())
         if self.fused is not None and self.fused.any:
             lines.append("  " + self.fused.text())
+        if self.resident is not None and self.resident.any:
+            lines.append("  " + self.resident.text())
         if self.adaptive is not None and self.adaptive.any:
             lines.append("  " + self.adaptive.text())
         if self.encoding is not None and self.encoding.any:

@@ -118,6 +118,10 @@ class DistributedQueryRunner:
         # cumulative count of fused-stage overflow fallbacks (whole-stage
         # compilation re-running a subplan on the legacy per-operator path)
         self.fused_fallbacks = 0
+        # cumulative count of resident-plan fallbacks (whole-query GSPMD
+        # compilation bailing to the task-per-worker path: state overflow,
+        # duplicate build keys, build failures)
+        self.resident_fallbacks = 0
         # system catalog (connectors/system.py): bind this runner so
         # dispatcher-tracked query state shows up in system.runtime.queries
         sysconn = self.catalog._connectors.get("system")
@@ -410,9 +414,20 @@ class DistributedQueryRunner:
         # batch-bucket plus one seam merge; the collective exchange and the
         # host buffers cover every remaining edge
         fused_edges: dict = {}
+        resident_edges: dict = {}
         if use_fused:
             fused_edges = plan_fused_stages(
                 fragments, self.session, task_counts, consumer_tasks)
+            # whole-query compilation (execution/plan_compiler.py): maximal
+            # device-resident subtrees — broadcast join spine + agg seam —
+            # run as ONE program per batch; a coalesced core fragment's
+            # plain fused seam is subsumed by its resident plan
+            from .plan_compiler import plan_resident_plans
+
+            resident_edges = plan_resident_plans(
+                fragments, self.session, task_counts, consumer_tasks)
+            for fid in resident_edges:
+                fused_edges.pop(fid, None)
         # device-collective REPARTITION edges (all_to_all over the mesh)
         # where producer/consumer task counts line up; host buffers remain
         # the fallback for every other edge
@@ -421,6 +436,7 @@ class DistributedQueryRunner:
             for f in fragments:
                 tc = stages[f.id].task_count
                 if (f.id not in fused_edges
+                        and f.id not in resident_edges
                         and f.output_kind == "REPARTITION"
                         and consumer_tasks.get(f.id) == tc
                         and collectives_available(tc)):
@@ -431,7 +447,8 @@ class DistributedQueryRunner:
         # dict as an argument so concurrent queries cannot cross-wire
         self._collective_edges = collective_edges
         self._fused_edges = fused_edges
-        edges = {**collective_edges, **fused_edges}
+        self._resident_edges = resident_edges
+        edges = {**collective_edges, **fused_edges, **resident_edges}
 
         errors: list[BaseException] = []
         adaptive = None
@@ -655,17 +672,36 @@ class DistributedQueryRunner:
             if errors:
                 if use_fused and any(isinstance(e, FusedStageOverflow)
                                      for e in errors):
-                    # a task saw more groups than the fused state cap: the
-                    # legacy per-operator path has no such limit — re-run
-                    # this subplan on it (FusedStageStats.fallbacks surfaces
-                    # the event; raise TRINO_TPU_FUSED_CAP to avoid it)
-                    self.fused_fallbacks += 1
-                    if stats_sink is not None:
-                        from ..exec.stats import FusedStageStats
+                    # a task saw more groups than the fused state cap (or a
+                    # resident plan couldn't hold): the legacy per-operator
+                    # path has no such limit — re-run this subplan on it
+                    # (stats surface the event; raise TRINO_TPU_FUSED_CAP /
+                    # fix the plan shape to avoid it)
+                    from .plan_compiler import ResidentPlanOverflow
 
-                        stats_sink.append(QueryStats(
-                            label="fused stages:",
-                            fused=FusedStageStats(fallbacks=1)))
+                    res = [e for e in errors
+                           if isinstance(e, ResidentPlanOverflow)]
+                    if res:
+                        self.resident_fallbacks += 1
+                        from ..telemetry import metrics as _tm
+
+                        _tm.RESIDENT_FALLBACKS.inc()
+                        if stats_sink is not None:
+                            from ..exec.stats import ResidentPlanStats
+
+                            stats_sink.append(QueryStats(
+                                label="resident plans:",
+                                resident=ResidentPlanStats(
+                                    fallbacks=1,
+                                    fallback_reasons=[str(res[0])[:120]])))
+                    else:
+                        self.fused_fallbacks += 1
+                        if stats_sink is not None:
+                            from ..exec.stats import FusedStageStats
+
+                            stats_sink.append(QueryStats(
+                                label="fused stages:",
+                                fused=FusedStageStats(fallbacks=1)))
                     return self._run_streaming(subplan, stats_sink, attempt,
                                                blacklist, use_fused=False)
                 raise errors[0]
@@ -688,6 +724,26 @@ class DistributedQueryRunner:
             if stats_sink is not None:
                 stats_sink.append(QueryStats(label="fused stages:",
                                              fused=roll))
+
+        if resident_edges:
+            from ..exec.stats import ResidentPlanStats
+
+            from .plan_compiler import ResidentPlanExec
+            from .tracing import annotate_resident_span
+
+            rroll = ResidentPlanStats()
+            for ex in resident_edges.values():
+                if isinstance(ex, ResidentPlanExec):
+                    rroll.merge(ex.rstats)
+            from ..telemetry.metrics import observe_resident
+
+            observe_resident(rroll)
+            span = self.tracer.current()
+            if span is not None:
+                annotate_resident_span(span, rroll)
+            if stats_sink is not None:
+                stats_sink.append(QueryStats(label="resident plans:",
+                                             resident=rroll))
 
         if adaptive is not None and adaptive.stats.any:
             from ..telemetry.metrics import observe_adaptive
@@ -976,10 +1032,25 @@ class DistributedQueryRunner:
         # fragment plans only its FEED subtree — the Filter/Project chain,
         # the PARTIAL aggregation and the seam shuffle run inside the fused
         # sink's jitted programs (execution/stage_compiler.py)
+        from .plan_compiler import (
+            ResidentBuildHandle,
+            ResidentBuildSinkOperator,
+            ResidentPlanExec,
+            ResidentPlanSinkOperator,
+        )
         from .stage_compiler import FusedStageExec, FusedStageSinkOperator
 
         ex = collective.get(f.id)
-        if isinstance(ex, FusedStageExec):
+        if isinstance(ex, ResidentPlanExec):
+            # a resident core fragment plans only the scan FEED below the
+            # join spine — joins, chain, PARTIAL agg and the interior seams
+            # all run inside the whole-plan program
+            local = planner.plan(ex.spec.feed)
+            sink = ResidentPlanSinkOperator(ex, task_index)
+        elif isinstance(ex, ResidentBuildHandle):
+            local = planner.plan(f.root)
+            sink = ResidentBuildSinkOperator(ex, task_index)
+        elif isinstance(ex, FusedStageExec):
             local = planner.plan(ex.spec.feed)
             sink = FusedStageSinkOperator(ex, task_index)
         elif ex is not None:

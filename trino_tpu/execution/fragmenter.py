@@ -13,10 +13,13 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 from typing import Optional
 
+import numpy as np
+
 from ..planner.plan import (
     Aggregate,
     Exchange,
     Filter,
+    Join,
     MatchRecognize,
     PlanNode,
     Project,
@@ -29,7 +32,8 @@ from ..planner.plan import (
 )
 from ..sql.ir import InputRef, referenced_inputs
 
-__all__ = ["PlanFragment", "SubPlan", "FusedSeam", "fragment_plan",
+__all__ = ["PlanFragment", "SubPlan", "FusedSeam", "ResidentEdge",
+           "ResidentJoin", "ResidentPlan", "fragment_plan",
            "mark_device_residency", "split_probe_fragment"]
 
 # Aggregate functions whose PARTIAL state merges with plain
@@ -60,6 +64,52 @@ class FusedSeam:
     out_spec: tuple = ("x",)   # consumer take sharding, dim 0
 
 
+@dataclass(frozen=True)
+class ResidentEdge:
+    """One interior exchange edge of a ResidentPlan with its PartitionSpec
+    contract.  BROADCAST edges gather build tables replicated (out_spec
+    ``()``); the terminal REPARTITION seam keeps dim 0 sharded on both
+    sides (``("x",) -> ("x",)``) so the compiled program inserts exactly
+    one in-program ``all_to_all`` and no resharding."""
+
+    producer_fid: int
+    consumer_fid: int
+    kind: str                  # BROADCAST | REPARTITION
+    axis: str = "x"
+    in_spec: tuple = ("x",)
+    out_spec: tuple = ("x",)
+
+
+@dataclass(frozen=True)
+class ResidentJoin:
+    """One broadcast hash join inlined into a resident-plan program.
+    ``probe_key`` indexes the probe-side schema at this join's depth
+    (feed columns ++ payloads of already-applied joins, bottom-up);
+    ``build_key`` indexes the build fragment's output schema."""
+
+    build_fid: int
+    join_type: str             # INNER | LEFT
+    probe_key: int
+    build_key: int
+    n_build_cols: int
+
+
+@dataclass(frozen=True)
+class ResidentPlan:
+    """A maximal connected subtree of device-resident fragments — a
+    multi-join broadcast tree feeding one PARTIAL->FINAL agg seam —
+    compiled by execution/plan_compiler.py as ONE jitted program over a
+    named mesh.  ``core_fid`` is the probe/agg fragment carrying the
+    terminal FusedSeam; ``joins`` are bottom-up along the probe spine."""
+
+    core_fid: int
+    consumer_fid: int
+    nk: int
+    joins: tuple[ResidentJoin, ...]
+    edges: tuple[ResidentEdge, ...]
+    fragment_ids: tuple[int, ...]
+
+
 @dataclass
 class PlanFragment:
     id: int
@@ -71,6 +121,8 @@ class PlanFragment:
     device_resident: bool = False   # every operator keeps batches on device
     fused_seam: Optional[FusedSeam] = None  # set when this fragment's
     #                                 REPARTITION edge is whole-stage fusable
+    resident_plan: Optional[ResidentPlan] = None  # set on the core fragment
+    #                                 of a coalesced whole-plan program
     sink_coalesce_rows: int = 0     # >0: the output sink buffers each
     #                                 partition's slivers into pages of
     #                                 about this many rows (adaptive
@@ -101,6 +153,10 @@ class SubPlan:
                 + (" device-resident" if f.device_resident else "")
                 + (f" fused-seam->f{f.fused_seam.consumer_fid}"
                    if f.fused_seam is not None else "")
+                + (f" resident-plan[{len(rp.fragment_ids)}f/"
+                   f"{len(rp.edges)}e]"
+                   if (rp := getattr(f, "resident_plan", None)) is not None
+                   else "")
                 + "]")
             lines.append(plan_text(f.root, 1))
         return "\n".join(lines)
@@ -237,6 +293,77 @@ def _match_fused_seam(producer: PlanFragment,
     return FusedSeam(producer.id, consumer.id, nk)
 
 
+def _resident_key_ok(t) -> bool:
+    """Join keys the in-program sorted-probe handles: plain integer lanes.
+    Dictionary codes on the PROBE side drift per batch (remapped host-side
+    before the launch); value-space decimals/doubles never reach broadcast
+    join keys in TPC-H shapes we inline."""
+    from ..spi.types import DecimalType
+    if t.is_dictionary_encoded or isinstance(t, DecimalType):
+        return False
+    return np.dtype(t.storage_dtype).kind in "iu"
+
+
+def _match_resident_plan(producer: PlanFragment,
+                         frags: dict[int, PlanFragment],
+                         rs_counts: dict[int, int],
+                         ) -> Optional[ResidentPlan]:
+    """Coalesce a maximal broadcast-join tree under an already-matched
+    FusedSeam into one ResidentPlan: the producer's probe spine must be
+    single-key BROADCAST INNER/LEFT joins whose build sides are
+    device-resident single-consumer SOURCE fragments, bottoming out in a
+    pure scan chain.  Every interior edge gets a PartitionSpec contract;
+    plan_compiler.py lowers the whole record to a single jitted program."""
+    seam = producer.fused_seam
+    if seam is None or rs_counts.get(producer.id, 0) != 1:
+        return None
+    node = producer.root.source            # Aggregate(PARTIAL).source
+    while isinstance(node, (Filter, Project)):
+        node = node.source
+    joins: list[ResidentJoin] = []
+    build_fids: list[int] = []
+    while isinstance(node, Join):
+        if (node.distribution != "BROADCAST"
+                or node.join_type not in ("INNER", "LEFT")
+                or node.residual is not None
+                or len(node.left_keys) != 1 or len(node.right_keys) != 1):
+            return None
+        rs = node.right
+        if not isinstance(rs, RemoteSource) or rs.kind != "BROADCAST":
+            return None
+        b = frags.get(rs.fragment_id)
+        if (b is None or not b.device_resident
+                or b.output_kind != "BROADCAST"
+                or b.partitioning != "SOURCE"
+                or b.source_fragments
+                or rs_counts.get(b.id, 0) != 1):
+            return None
+        pk_t = node.left.output_types[node.left_keys[0]]
+        bk_t = rs.output_types[node.right_keys[0]]
+        if not (_resident_key_ok(pk_t) and _resident_key_ok(bk_t)):
+            return None
+        joins.append(ResidentJoin(b.id, node.join_type, node.left_keys[0],
+                                  node.right_keys[0], len(rs.output_types)))
+        build_fids.append(b.id)
+        node = node.left
+    if not joins:
+        return None
+    if any(isinstance(n, RemoteSource) for n in _walk(node)):
+        return None                        # feed must be a pure scan chain
+    if set(producer.source_fragments) != set(build_fids):
+        return None
+    joins.reverse()                        # bottom-up along the probe spine
+    edges = tuple(
+        ResidentEdge(fid, producer.id, "BROADCAST", out_spec=())
+        for fid in build_fids
+    ) + (ResidentEdge(producer.id, seam.consumer_fid, "REPARTITION"),)
+    return ResidentPlan(
+        core_fid=producer.id, consumer_fid=seam.consumer_fid, nk=seam.nk,
+        joins=tuple(joins), edges=edges,
+        fragment_ids=tuple(sorted({producer.id, seam.consumer_fid,
+                                   *build_fids})))
+
+
 def mark_device_residency(subplan: SubPlan) -> SubPlan:
     """Bottom-up TPU-residency propagation + fused-seam recording.
 
@@ -258,6 +385,17 @@ def mark_device_residency(subplan: SubPlan) -> SubPlan:
             seam = _match_fused_seam(producer, consumer)
             if seam is not None:
                 producer.fused_seam = seam
+    # RemoteSource reference counts gate whole-plan coalescing: a build
+    # or core fragment consumed from more than one site can't fold into
+    # one program without duplicating work.
+    rs_counts: dict[int, int] = {}
+    for f in frags.values():
+        for n in _walk(f.root):
+            if isinstance(n, RemoteSource):
+                rs_counts[n.fragment_id] = rs_counts.get(n.fragment_id, 0) + 1
+    for f in frags.values():
+        if f.fused_seam is not None:
+            f.resident_plan = _match_resident_plan(f, frags, rs_counts)
     return subplan
 
 

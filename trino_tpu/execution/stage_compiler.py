@@ -246,6 +246,14 @@ class _AccumulateProgram:
 
     def __init__(self, spec: FusedStageSpec, in_types, in_dicts):
         self.spec = spec
+        self._compile_chain(in_types, in_dicts)
+        self._fn = jax.jit(self._run, donate_argnums=(0,))
+        # one launch for the whole zero pytree (it is immediately donated to
+        # the first accumulate call, so every task needs fresh buffers)
+        self._init_fn = jax.jit(self._initial_state)
+
+    def _compile_chain(self, in_types, in_dicts):
+        spec = self.spec
         types = list(in_types)
         dicts = list(in_dicts)
         steps = []
@@ -265,10 +273,6 @@ class _AccumulateProgram:
         self.out_types = types
         # chain-output dictionaries: what the carried state's key codes mean
         self.key_dicts = [dicts[c] for c in spec.partial.group_keys]
-        self._fn = jax.jit(self._run, donate_argnums=(0,))
-        # one launch for the whole zero pytree (it is immediately donated to
-        # the first accumulate call, so every task needs fresh buffers)
-        self._init_fn = jax.jit(self._initial_state)
 
     def initial_state(self) -> dict:
         return self._init_fn()
@@ -291,15 +295,18 @@ class _AccumulateProgram:
 
     # -- traced body --------------------------------------------------------
     def _run(self, state, cols, live, batch_remaps, state_remaps):
+        n = cols[0][0].shape[0]
+        cols, live, batch_err = self._apply_chain(cols, live, n)
+        return self._agg_merge(state, cols, live, batch_remaps,
+                               state_remaps, n, batch_err)
+
+    def _apply_chain(self, cols, live, n):
         from ..ops.expr import (
             expr_condition_mask,
             expr_error_scope,
             reduce_error_lanes,
         )
 
-        spec = self.spec
-        cap = spec.cap
-        n = cols[0][0].shape[0]
         # ---- Filter/Project chain (mirrors FilterProjectOperator.run) -----
         with expr_error_scope() as errs:
             for kind, compiled, out_dtypes in self.steps:
@@ -328,7 +335,12 @@ class _AccumulateProgram:
             err = reduce_error_lanes(errs, (n,))
         batch_err = (jnp.zeros((), jnp.int32) if err is None
                      else jnp.max(err).astype(jnp.int32))
+        return cols, live, batch_err
 
+    def _agg_merge(self, state, cols, live, batch_remaps, state_remaps,
+                   n, batch_err):
+        spec = self.spec
+        cap = spec.cap
         # ---- partial aggregation of this batch ----------------------------
         keys, kvalids = [], []
         for j, ch in enumerate(spec.partial.group_keys):

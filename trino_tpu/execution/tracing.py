@@ -18,7 +18,8 @@ from typing import Callable, Optional
 
 __all__ = ["Span", "Tracer", "traceparent", "parse_traceparent",
            "annotate_scan_span", "annotate_sync_span",
-           "annotate_resilience_span", "annotate_fused_span"]
+           "annotate_resilience_span", "annotate_fused_span",
+           "annotate_resident_span"]
 
 
 def _new_trace_id() -> str:
@@ -65,6 +66,26 @@ def annotate_fused_span(span: "Span", fs) -> None:
     span.set("trino.fused.cache-hits", fs.cache_hits)
     span.set("trino.fused.seam-merges", fs.merges)
     span.set("trino.fused.fallbacks", fs.fallbacks)
+
+
+def annotate_resident_span(span: "Span", rs) -> None:
+    """Set the ``trino.resident.*`` attributes from a ResidentPlanStats
+    roll-up (exec/stats.py): whole-plan program counts, in-program seam
+    fusion and the launches/batch figure next to the query wall time."""
+    if rs is None or not rs.any:
+        return
+    span.set("trino.resident.plans", rs.plans)
+    span.set("trino.resident.seams", rs.seams)
+    span.set("trino.resident.batches", rs.batches)
+    span.set("trino.resident.input-rows", rs.input_rows)
+    span.set("trino.resident.jit-calls", rs.jit_calls)
+    span.set("trino.resident.programs", rs.programs)
+    span.set("trino.resident.cache-hits", rs.cache_hits)
+    span.set("trino.resident.launches-per-batch",
+             round(rs.launches_per_batch, 3))
+    span.set("trino.resident.code-seam-columns", rs.code_seam_columns)
+    span.set("trino.resident.merges", rs.merges)
+    span.set("trino.resident.fallbacks", rs.fallbacks)
 
 
 def annotate_resilience_span(span: "Span", res) -> None:

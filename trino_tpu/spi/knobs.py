@@ -124,6 +124,10 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_LEGACY_EXPAND", "bool", "0",
          "1 restores the legacy per-run join expand (pre padded "
          "single-fetch)."),
+    Knob("TRINO_TPU_MESH_SHAPE", "str", "",
+         "Mesh-shape override for resident-plan programs (\"8\" or "
+         "\"2x4\"); the dimension product caps the mesh width a plan may "
+         "claim.  Unset sizes the mesh from the stage task count."),
     Knob("TRINO_TPU_OOM_POLICY", "enum", "largest_query",
          "Victim selection policy for the cluster low-memory killer.",
          choices=("largest_query", "lowest_priority", "youngest")),
@@ -164,6 +168,14 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_QUERY_STATE_DIR", "path", "",
          "Query-state WAL directory; unset uses a per-uid tempdir next to "
          "the query journal."),
+    Knob("TRINO_TPU_RESIDENT_MAX_FRAGMENTS", "int", "8",
+         "Largest fragment count one resident-plan program may absorb; "
+         "bigger coalesced subtrees stay on the fused/legacy path."),
+    Knob("TRINO_TPU_RESIDENT_PLAN", "enum", "auto",
+         "Whole-query GSPMD compilation (one program per maximal "
+         "TPU-resident plan); 0 keeps the task-per-worker fused/legacy "
+         "path bit-for-bit.",
+         choices=("auto", "1", "0")),
     Knob("TRINO_TPU_RESOURCE_GROUPS", "json", "",
          "Hierarchical resource-group tree (weights, concurrency and "
          "queue limits, selectors) as JSON; unset uses one flat default "

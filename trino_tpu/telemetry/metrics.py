@@ -35,7 +35,8 @@ from typing import Optional
 __all__ = [
     "Counter", "Gauge", "Distribution", "MetricsRegistry", "REGISTRY",
     "observe_scan", "observe_sync", "observe_resilience", "observe_fused",
-    "observe_exchange", "observe_adaptive", "observe_encoding",
+    "observe_resident", "observe_exchange", "observe_adaptive",
+    "observe_encoding",
     "update_device_memory_watermark",
 ]
 
@@ -453,6 +454,28 @@ FUSED_COMPILE_SECONDS = REGISTRY.distribution(
     "trino_fused_compile_seconds",
     "wall time of fused-program trace+compile dispatches", lo=1e-3)
 
+# whole-query compilation (execution/plan_compiler.py)
+RESIDENT_PLANS = REGISTRY.counter(
+    "trino_resident_plans_total", "maximal TPU-resident plans executed")
+RESIDENT_PROGRAMS = REGISTRY.counter(
+    "trino_resident_programs_total",
+    "distinct (resident program, bucket) traces compiled")
+RESIDENT_SEAMS = REGISTRY.counter(
+    "trino_resident_seams_total",
+    "interior exchange edges fused inside resident-plan programs")
+RESIDENT_BATCHES = REGISTRY.counter(
+    "trino_resident_batches_total",
+    "probe batches absorbed by resident-plan programs")
+RESIDENT_JIT_CALLS = REGISTRY.counter(
+    "trino_resident_jit_calls_total",
+    "whole-plan program dispatches (one per probe batch)")
+RESIDENT_CODE_SEAMS = REGISTRY.counter(
+    "trino_resident_code_seam_columns_total",
+    "dictionary-code lanes that crossed an interior seam unmaterialized")
+RESIDENT_FALLBACKS = REGISTRY.counter(
+    "trino_resident_fallbacks_total",
+    "resident-plan overflow/dup-key fallbacks to the legacy path")
+
 # exchange HTTP plane (execution/remote.py HttpExchangeClient + worker serve)
 EXCHANGE_BYTES = REGISTRY.counter(
     "trino_exchange_bytes_total", "exchange page bytes moved over HTTP")
@@ -714,6 +737,19 @@ def observe_fused(fs) -> None:
     FUSED_CACHE_HITS.inc(fs.cache_hits)
     FUSED_MERGES.inc(fs.merges)
     FUSED_FALLBACKS.inc(fs.fallbacks)
+
+
+def observe_resident(rs) -> None:
+    """Fold a ResidentPlanStats roll-up.  ``programs`` and
+    ``code_seam_columns`` are recorded at their event sites
+    (execution/plan_compiler.py), mirroring the observe_fused contract."""
+    if rs is None or not rs.any:
+        return
+    RESIDENT_PLANS.inc(rs.plans)
+    RESIDENT_SEAMS.inc(rs.seams)
+    RESIDENT_BATCHES.inc(rs.batches)
+    RESIDENT_JIT_CALLS.inc(rs.jit_calls)
+    RESIDENT_FALLBACKS.inc(rs.fallbacks)
 
 
 def observe_exchange(nbytes: int, pages: int, wait_s: float) -> None:

@@ -42,8 +42,8 @@ from collections import OrderedDict
 from typing import Optional
 
 __all__ = [
-    "OPERATOR", "FUSED", "EXCHANGE", "STAGE", "SPILL", "SPECULATION",
-    "TASK", "ADAPTIVE", "RECOVERY",
+    "OPERATOR", "FUSED", "RESIDENT", "EXCHANGE", "STAGE", "SPILL",
+    "SPECULATION", "TASK", "ADAPTIVE", "RECOVERY",
     "level", "enabled", "is_full", "set_level", "event", "instant",
     "now", "set_context", "capture_context", "apply_context", "sync_batch",
     "collect", "harvest", "add_remote_events", "take_task_events",
@@ -53,6 +53,7 @@ __all__ = [
 # event kinds (the ``cat`` field of the chrome trace)
 OPERATOR = "operator"
 FUSED = "fused-region"
+RESIDENT = "resident-plan"  # trino.resident.* whole-plan program track
 EXCHANGE = "exchange-wait"
 STAGE = "batch-staged"
 SPILL = "spill"

@@ -31,7 +31,9 @@ see run_qps_bench; BENCH_QPS_DURATION/BENCH_QPS_SF/BENCH_QPS_CLIENTS),
 run_warm_bench; BENCH_WARM_SF/BENCH_WARM_REPS), ``--adaptive`` (adaptive
 execution on/off A/B over a skewed-key TPC-H variant and a mis-estimated
 broadcast plan, see run_adaptive_bench; BENCH_ADAPTIVE_SF/
-BENCH_ADAPTIVE_WORKERS).
+BENCH_ADAPTIVE_WORKERS), ``--hbo`` (history-based optimization second-run
+leg over the same mis-estimated broadcast plan, see run_hbo_bench; same
+env knobs as --adaptive).
 """
 
 from __future__ import annotations
@@ -740,9 +742,11 @@ def run_warm_bench(write: bool = True) -> dict:
 # static hash-partitioned join lands most of the work on a single task; the
 # runtime skew split fans that key out across several probe tasks.  count and
 # a DECIMAL sum only: both are exact and summation-order independent, so the
-# off/on row comparison is bit-for-bit even though the split reorders pages
+# off/on row comparison is bit-for-bit even though the split reorders pages.
+# The sum spans both join sides so the iterative optimizer cannot compact
+# the heavy key away with a pre-join partial aggregation
 _ADAPTIVE_SKEW_SQL = """
-select count(*) n, sum(p.o_totalprice) s
+select count(*) n, sum(p.o_totalprice + b.c_acctbal) s
 from (select case when o_orderkey % 5 < 4 then 1
              else o_custkey end as k, o_totalprice from orders) p
 join (select c_custkey, c_acctbal from customer) b on p.k = b.c_custkey
@@ -892,6 +896,196 @@ def run_adaptive_bench(write: bool = True) -> dict:
     if write:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_r13.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
+def _hbo_walk(node):
+    yield node
+    for c in node.children:
+        yield from _hbo_walk(c)
+
+
+def _hbo_build_side(runner, sql: str) -> dict:
+    """Plan (no execution) and report the sole join's distribution plus
+    which base tables feed its build (right) side, following remote
+    exchanges across fragments."""
+    from trino_tpu.planner.plan import Join, RemoteSource, TableScan
+
+    frags = runner.create_subplan(sql).all_fragments()
+    by_id = {f.id: f for f in frags}
+    join = next(n for f in frags for n in _hbo_walk(f.root)
+                if isinstance(n, Join))
+
+    def tables(node, seen):
+        out = set()
+        for n in _hbo_walk(node):
+            if isinstance(n, TableScan):
+                out.add(n.table)
+            elif isinstance(n, RemoteSource) and n.fragment_id not in seen:
+                seen.add(n.fragment_id)
+                out |= tables(by_id[n.fragment_id].root, seen)
+        return out
+
+    return {"distribution": join.distribution,
+            "build_tables": sorted(tables(join.right, set()))}
+
+
+@_result_cache_off
+def _hbo_second_run(sql: str, sf: float, workers: int, iters: int) -> dict:
+    """Three runs of the BENCH_r13 wrong-side-broadcast leg against one
+    isolated history journal:
+
+    - **static** — HBO=0, adaptive=0: the mis-estimated BROADCAST plan
+      runs uncorrected (reference floor; records nothing).
+    - **run1** — HBO=1, adaptive=1: the first execution still plans
+      BROADCAST (empty history), the runtime flip corrects it at the
+      activation barrier AND the observed stats are journaled at query
+      end.
+    - **run2** — HBO=1, adaptive=0: a fresh runner re-plans from history
+      and must choose PARTITIONED up front — no runtime correction left.
+    """
+    import tempfile
+
+    from trino_tpu import caching
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.planner.history import reset_for_test as history_reset
+    from trino_tpu.planner.iterative.driver import last_report
+    from trino_tpu.planner.plan import Join
+    from trino_tpu.runner import Session
+    from trino_tpu.telemetry import runtime as rt
+
+    env = {
+        "TRINO_TPU_JOURNAL_DIR": tempfile.mkdtemp(prefix="hbo_bench_"),
+        # plan-time history and the adaptive activation barrier compare
+        # observed build bytes against the SAME threshold: 1 MiB, far
+        # under the real orders build side at this scale factor
+        "TRINO_TPU_BROADCAST_THRESHOLD_BYTES": str(1 << 20),
+    }
+    saved = {k: os.environ.get(k) for k in list(env) + ["TRINO_TPU_HBO"]}
+    os.environ.update(env)
+    try:
+        out: dict = {}
+        rows: dict[str, list] = {}
+
+        def fresh_runner(hbo: str, adaptive: str):
+            os.environ["TRINO_TPU_HBO"] = hbo
+            caching.reset_for_test()
+            history_reset()
+            return DistributedQueryRunner(
+                default_catalog(scale_factor=sf), worker_count=workers,
+                session=Session(node_count=workers, adaptive=adaptive))
+
+        def timed(r, name: str) -> None:
+            samples = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                res = r.execute(sql)
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            rows[name] = sorted(res.rows())
+            out[f"wall_s_{name}"] = round(samples[len(samples) // 2], 3)
+
+        # static floor: wrong BROADCAST, nothing corrects it, no recording
+        r = fresh_runner(hbo="0", adaptive="0")
+        out["static_plan"] = _hbo_build_side(r, sql)
+        r.execute(sql)  # warmup: compile every jitted program
+        timed(r, "static")
+
+        # run 1: adaptive corrects at runtime, stats land in the journal
+        r = fresh_runner(hbo="1", adaptive="1")
+        out["run1_first_plan"] = _hbo_build_side(r, sql)
+        r.execute(sql)  # warmup; also the first history-recorded execution
+        out["run1_decisions"] = rt.queries()[-1].adaptive_decisions
+        timed(r, "run1")
+
+        # run 2: fresh runner, second-run planning — history must pick the
+        # correct build side before a single row moves
+        r = fresh_runner(hbo="1", adaptive="0")
+        out["run2_plan"] = _hbo_build_side(r, sql)
+        rep = last_report()
+        if rep is not None:
+            out["run2_planning_ms"] = round(rep.planning_ms, 2)
+            out["run2_history_lookups"] = rep.history_lookups
+            out["run2_history_hits"] = rep.history_hits
+        r.execute(sql)  # warmup
+        out["run2_decisions"] = rt.queries()[-1].adaptive_decisions
+        timed(r, "run2")
+
+        out["rows_identical"] = (rows["static"] == rows["run1"] ==
+                                 rows["run2"])
+        out["wall_ratio_run2_vs_run1"] = round(
+            out["wall_s_run2"] / max(out["wall_s_run1"], 1e-9), 3)
+        out["speedup_vs_static"] = round(
+            out["wall_s_static"] / max(out["wall_s_run2"], 1e-9), 2)
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_hbo_bench(write: bool = True) -> dict:
+    """``bench.py --hbo``: the history-based-optimization acceptance leg.
+
+    Re-runs the BENCH_r13 wrong-side-broadcast mis-estimate with history
+    in the loop: run 1 (adaptive on, empty history) plans the broadcast
+    wrong and gets corrected at runtime while plan_stats are journaled;
+    run 2 (HBO on, adaptive OFF) must plan the correct PARTITIONED build
+    side up front from the recorded stats, with wall <= 1.15x the
+    adaptive-on run-1 wall, identical rows, and planning-time overhead
+    recorded from the iterative optimizer trace.
+
+    Env knobs: BENCH_ADAPTIVE_SF (default 0.3), BENCH_ADAPTIVE_WORKERS
+    (default 4), BENCH_ITERS (default 3).  Writes BENCH_r18.json."""
+    sf = float(os.environ.get("BENCH_ADAPTIVE_SF", "0.3"))
+    workers = int(os.environ.get("BENCH_ADAPTIVE_WORKERS", "4"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    _ensure_backend()
+    _enable_compile_cache()
+
+    from trino_tpu.telemetry.metrics import REGISTRY
+
+    print(f"hbo second-run: sf={sf:g} workers={workers}", file=sys.stderr)
+    leg = _hbo_second_run(_ADAPTIVE_WRONG_SQL, sf, workers, iters)
+    print(f"wrong_side_broadcast: {leg}", file=sys.stderr)
+
+    # run2 may carry plan-time hbo_fanout tags, but every RUNTIME
+    # correction (flip/skew-split) must be gone: history planned it right.
+    # "correct build side up front" = the mis-estimated orders subquery is
+    # no longer the broadcast build; with true stats the reorderer either
+    # partitions it or puts the genuinely small customer side on build.
+    runtime_fixes = ("flip_to" in leg["run2_decisions"]
+                     or "skew_split" in leg["run2_decisions"])
+    ok = (leg["rows_identical"]
+          and leg["static_plan"] == {"distribution": "BROADCAST",
+                                     "build_tables": ["orders"]}
+          and leg["run1_first_plan"] == leg["static_plan"]
+          and "flip_to_partitioned" in leg["run1_decisions"]
+          and "orders" not in leg["run2_plan"]["build_tables"]
+          and not runtime_fixes
+          and leg["wall_ratio_run2_vs_run1"] <= 1.15)
+    result = {
+        "metric": f"hbo_second_run_wall_ratio_sf{sf:g}",
+        "value": leg["wall_ratio_run2_vs_run1"],
+        "unit": "run-2 wall (HBO=1, adaptive=0) / adaptive-on run-1 wall "
+                "(target <= 1.15; run-2 must plan the build side right "
+                "up front)",
+        "workers": workers,
+        "iters": iters,
+        "wrong_side_broadcast": leg,
+        "pass": ok,
+        "metrics": {k: v for k, v in REGISTRY.snapshot().items()
+                    if k.startswith(("trino_hbo", "trino_optimizer"))},
+    }
+    print(json.dumps(result))
+    if write:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r18.json"), "w") as f:
             json.dump(result, f, indent=1)
             f.write("\n")
     return result
@@ -1783,6 +1977,9 @@ def main() -> None:
         return
     if "--adaptive" in sys.argv:
         run_adaptive_bench()
+        return
+    if "--hbo" in sys.argv:
+        run_hbo_bench()
         return
     if "--encoded-leg" in sys.argv:
         run_encoded_leg()

@@ -9,6 +9,15 @@ XLA_FLAGS still works because the CPU client is only created on first use.
 """
 
 import os
+import tempfile
+
+# History-based optimization makes planning stateful across *processes* by
+# design (the journal is durable): a polluted host journal would make every
+# plan-shape assertion depend on what ran before.  The suite gets a fresh
+# journal per run and pins HBO off; test_hbo opts back in per-fixture.
+os.environ["TRINO_TPU_JOURNAL_DIR"] = tempfile.mkdtemp(
+    prefix="trino-tpu-test-journal-")
+os.environ["TRINO_TPU_HBO"] = "0"
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

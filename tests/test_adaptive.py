@@ -33,8 +33,11 @@ JOIN_SQL = ("select c.c_mktsegment, count(*) n, sum(o.o_totalprice) s "
             "from orders o join customer c on o.o_custkey = c.c_custkey "
             "group by c.c_mktsegment order by 1")
 
-# half the probe rows collapse onto key 1: the canonical heavy hitter
-SKEW_SQL = ("select count(*) n, sum(p.o_totalprice) s "
+# half the probe rows collapse onto key 1: the canonical heavy hitter.
+# The sum spans BOTH join sides so the iterative optimizer cannot
+# pre-aggregate the probe below the join (which would compact the heavy
+# key to one row at plan time and leave the runtime split nothing to do)
+SKEW_SQL = ("select count(*) n, sum(p.o_totalprice + b.c_acctbal) s "
             "from (select case when o_orderkey % 2 = 0 then 1 "
             "             else o_custkey end as k, o_totalprice "
             "      from orders) p "

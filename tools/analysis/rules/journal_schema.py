@@ -48,12 +48,46 @@ def lint_record(rec: dict) -> list:
         if field not in rec:
             problems.append(f"{event}: missing required field {field!r}")
     for k, v in rec.items():
+        if event == "plan_stats" and k == "nodes":
+            # the one sanctioned nested field (schema v2): fingerprint ->
+            # {rows/bytes/groups/skew scalars}
+            problems.extend(_lint_plan_stats_nodes(v))
+            continue
         if not isinstance(v, _SCALARS):
             problems.append(
                 f"{event}: field {k!r} is {type(v).__name__}, not a "
                 f"JSON scalar")
         if isinstance(v, float) and not math.isfinite(v):
             problems.append(f"{event}: field {k!r} is non-finite ({v})")
+    return problems
+
+
+def _lint_plan_stats_nodes(nodes) -> list:
+    from trino_tpu.telemetry import journal
+
+    if not isinstance(nodes, dict):
+        return [f"plan_stats: nodes is {type(nodes).__name__}, not a dict"]
+    problems = []
+    for fp, st in nodes.items():
+        if not isinstance(fp, str):
+            problems.append(f"plan_stats: fingerprint {fp!r} is not a str")
+        if not isinstance(st, dict):
+            problems.append(f"plan_stats: nodes[{fp!r}] is not a dict")
+            continue
+        if not st:
+            problems.append(f"plan_stats: nodes[{fp!r}] is empty")
+        for k, v in st.items():
+            if k not in journal.PLAN_STATS_FIELDS:
+                problems.append(
+                    f"plan_stats: nodes[{fp!r}] has unknown field {k!r} "
+                    f"(allowed: {journal.PLAN_STATS_FIELDS})")
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(
+                    f"plan_stats: nodes[{fp!r}][{k!r}] is "
+                    f"{type(v).__name__}, not a number")
+            elif isinstance(v, float) and not math.isfinite(v):
+                problems.append(
+                    f"plan_stats: nodes[{fp!r}][{k!r}] is non-finite")
     return problems
 
 
@@ -66,7 +100,7 @@ def run() -> list:
     if not records:
         return ["journal.sample_records() returned no records"]
     events = {r.get("event") for r in records}
-    for required in ("query_created", "query_completed"):
+    for required in ("query_created", "query_completed", "plan_stats"):
         if required not in events:
             problems.append(f"no sample record for event {required!r}")
     for rec in records:

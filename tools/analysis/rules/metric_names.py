@@ -38,11 +38,12 @@ KINDS = ("counter", "gauge", "distribution")
 # metric families the observability plane is contractually expected to
 # expose (PR 11 flight recorder, PR 12 cache plane, PR 13 adaptive, PR 15
 # fault-tolerant execution, PR 16 compressed execution, PR 17 resident
-# plans): at least one registration of each must exist, so a refactor
-# can't silently drop that telemetry
+# plans, PR 18 iterative optimizer + history-based optimization): at least
+# one registration of each must exist, so a refactor can't silently drop
+# that telemetry
 REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_",
                      "trino_adaptive_", "trino_fte_", "trino_encoding_",
-                     "trino_resident_")
+                     "trino_resident_", "trino_optimizer_", "trino_hbo_")
 
 
 def _registrations(tree: ast.Module, lines: list) -> list:

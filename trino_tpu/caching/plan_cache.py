@@ -46,6 +46,8 @@ PLANNING_ENV_KNOBS = (
     "TRINO_TPU_HASH_IMPL", "TRINO_TPU_FUSED_STAGE", "TRINO_TPU_FUSED_CAP",
     "TRINO_TPU_SYNC_FREE", "TRINO_TPU_LEGACY_EXPAND",
     "TRINO_TPU_TPCH_VECTOR_DECODE", "TRINO_TPU_PREFETCH",
+    "TRINO_TPU_OPTIMIZER", "TRINO_TPU_HBO",
+    "TRINO_TPU_JOIN_REORDER_DP_LIMIT", "TRINO_TPU_BROADCAST_ROW_LIMIT",
 )
 
 # session properties that shape the logical plan or the execution layout
@@ -149,9 +151,14 @@ def _key(sql: str, session, catalog, flavor: str) -> tuple:
     # instance id keeps the process-global cache partitioned per catalog:
     # two runners with fresh catalogs (and fresh memory connectors) must
     # never see each other's plans or results
+    # the history epoch keys out plans shaped by observed stats: new
+    # plan_stats records -> new epoch -> cached history-driven plans
+    # cannot outlive (or poison) the history that shaped them
+    from ..planner.history import history_epoch
+
     return (flavor, fingerprint(sql), sql.strip(), session_key(session),
             planning_env_key(), getattr(catalog, "instance_id", id(catalog)),
-            getattr(catalog, "generation", 0))
+            getattr(catalog, "generation", 0), history_epoch())
 
 
 def lookup(sql: str, session, catalog,

@@ -518,6 +518,23 @@ class AdaptiveExec:
     def is_deferred_producer(self, fid: int) -> bool:
         return fid in self._edge_by_producer
 
+    def observed_stats(self) -> dict:
+        """Per deferred-producer fragment: exact rows/bytes observed at the
+        activation barrier (staging is single-partition, so the counters
+        are not inflated by broadcast fan-out) plus the folded sketch's
+        heavy-hitter share — the feed for history-based optimization."""
+        out: dict[int, dict] = {}
+        for fid, e in self._edge_by_producer.items():
+            entry = {
+                "rows": sum(b.rows_enqueued for b in e.staging),
+                "bytes": e.bytes_observed(),
+            }
+            sk = e.fold_sketch()
+            if sk is not None and sk.total:
+                entry["skew"] = max(sk.counts.values(), default=0) / sk.total
+            out[fid] = entry
+        return out
+
     def done(self) -> bool:
         return self._aborted or (
             all(s.resolved for s in self.sites)

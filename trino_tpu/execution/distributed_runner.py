@@ -753,6 +753,29 @@ class DistributedQueryRunner:
                 stats_sink.append(QueryStats(label="adaptive:",
                                              adaptive=adaptive.stats))
 
+        # close the runtime-truth loop: journal per-fingerprint observed
+        # stats so the NEXT run of this (or any row-equivalent) plan shape
+        # costs joins/aggregations from reality (planner/history.py)
+        try:
+            from ..planner.history import record_query_stats
+            from ..telemetry import runtime as _rt
+
+            qrec = _rt.current_record()
+            skip = (set(fused_edges) | set(resident_edges)
+                    | set(collective_edges))
+            n = record_query_stats(
+                fragments, stages, skip, adaptive,
+                qrec.query_id if qrec is not None else mem_qid,
+                qrec.fingerprint if qrec is not None else "")
+            if n:
+                from ..telemetry.metrics import HBO_RECORDS
+
+                HBO_RECORDS.inc(n)
+        except Exception:
+            from ..telemetry.metrics import HBO_RECORD_ERRORS
+
+            HBO_RECORD_ERRORS.inc()
+
         # drain the root stage's buffer as the client
         from .task import maybe_deserialize
 
@@ -930,11 +953,67 @@ class DistributedQueryRunner:
                 task_counts[f.id] = writer_cap
             else:
                 task_counts[f.id] = workers
+        self._history_fanout(fragments, task_counts, workers)
         consumer_tasks: dict[int, int] = {}
         for f in fragments:
             for src in f.source_fragments:
                 consumer_tasks[src] = task_counts[f.id]
         return task_counts, consumer_tasks
+
+    def _history_fanout(self, fragments, task_counts: dict,
+                        workers: int) -> None:
+        """Shrink a hash stage's task count when history says its input is
+        small: N tasks each jitting a program over a trickle of rows costs
+        more than the parallelism buys.  Only ever shrinks — an
+        underestimate here cannot break correctness, just parallelism —
+        and only for intermediate (non-scan, non-SINGLE) stages."""
+        try:
+            from ..planner.history import (
+                fragment_fingerprints,
+                hbo_enabled,
+                _stats_table,
+            )
+            from ..spi import knobs
+
+            if not hbo_enabled():
+                return
+            per_task = knobs.get_int("TRINO_TPU_HBO_ROWS_PER_TASK") or 0
+            if per_task <= 0:
+                return
+            table, _ = _stats_table()
+            if not table:
+                return
+            fps = fragment_fingerprints(fragments)
+            by_id = {f.id: f for f in fragments}
+            for f in fragments:
+                if task_counts.get(f.id, 1) <= 1 or f.partitioning != "HASH":
+                    continue
+                rows = 0
+                for src in f.source_fragments:
+                    st = table.get(fps.get(src, ""))
+                    n = None if st is None else (
+                        st.rows if st.rows is not None else st.groups)
+                    if n is None or src not in by_id:
+                        rows = None
+                        break
+                    rows += n
+                if rows is None:
+                    continue
+                t = max(1, min(workers, -(-rows // per_task)))
+                if t < task_counts[f.id]:
+                    task_counts[f.id] = t
+                    from ..telemetry import runtime as _rt
+                    from ..telemetry.metrics import HBO_FANOUT_ADJUSTED
+
+                    HBO_FANOUT_ADJUSTED.inc()
+                    qrec = _rt.current_record()
+                    if qrec is not None:
+                        _rt.add_adaptive(qrec, f"hbo_fanout:f{f.id}:{t}")
+        except Exception:
+            # advisory only: a failed adjustment must never fail scheduling
+            from ..telemetry.metrics import HBO_RECORD_ERRORS
+
+            HBO_RECORD_ERRORS.inc()
 
     def _to_result(self, subplan: SubPlan, batches: list) -> QueryResult:
         names = list(subplan.fragment.root.output_names)

@@ -1,0 +1,3 @@
+"""Rule library for the iterative optimizer (reference:
+sql/planner/iterative/rule/ — each module groups the miniatures of the
+correspondingly-named Trino rules)."""

@@ -48,14 +48,43 @@ from .plan import (
     Window,
 )
 
-__all__ = ["optimize", "estimate_rows"]
+__all__ = ["optimize", "estimate_rows", "optimizer_mode", "final_passes"]
 
 _BROADCAST_LIMIT = 2_000_000  # build rows below this replicate to every task
 
+# Damped selectivity of one extra equality join clause whose NDV is unknown
+# (Trino's UNKNOWN_FILTER_COEFFICIENT idiom): before the fix, every clause
+# past the first contributed selectivity 1.0, so stacked conjuncts never
+# tightened a join estimate at all.
+_EXTRA_JOIN_CLAUSE_SEL = 0.9
+
+
+def optimizer_mode() -> str:
+    """iterative | legacy (TRINO_TPU_OPTIMIZER; legacy is the bit-for-bit
+    single-pass pipeline below)."""
+    from ..spi import knobs
+
+    mode = knobs.get_str("TRINO_TPU_OPTIMIZER").strip().lower()
+    return mode if mode in ("iterative", "legacy") else "iterative"
+
 
 def optimize(root: PlanNode, catalog: Catalog) -> PlanNode:
+    if optimizer_mode() == "iterative":
+        from .iterative import optimize_iterative
+
+        return optimize_iterative(root, catalog)
+    return _optimize_legacy(root, catalog)
+
+
+def _optimize_legacy(root: PlanNode, catalog: Catalog) -> PlanNode:
     node, mapping = _rewrite(root, catalog)
     assert mapping == list(range(len(node.output_types))), "root remap escaped"
+    return final_passes(node, catalog)
+
+
+def final_passes(node: PlanNode, catalog: Catalog) -> PlanNode:
+    """Mapping-free tail passes both optimizer modes share: column pruning,
+    advisory scan constraints, LIMIT-into-scan."""
     node = _prune(node, set(range(len(node.output_types))))[0]
     node = _attach_scan_constraints(node)
     node = _push_limit_into_scan(node, catalog)
@@ -214,7 +243,11 @@ def _conjunct_selectivity(c: RowExpression, source: PlanNode,
     return 0.3
 
 
-def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
+def estimate_rows(node: PlanNode, catalog: Catalog, history=None) -> float:
+    if history is not None:
+        observed = history.observed_rows(node)
+        if observed is not None:
+            return float(observed)
     if isinstance(node, TableScan):
         stats = catalog.connector(node.catalog).get_table_statistics(node.table)
         r = stats.row_count
@@ -223,11 +256,11 @@ def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
         sel = 1.0
         for c in _split_and(node.predicate):
             sel *= _conjunct_selectivity(c, node.source, catalog)
-        return estimate_rows(node.source, catalog) * max(sel, 1e-9)
+        return estimate_rows(node.source, catalog, history) * max(sel, 1e-9)
     if isinstance(node, Project):
-        return estimate_rows(node.source, catalog)
+        return estimate_rows(node.source, catalog, history)
     if isinstance(node, Aggregate):
-        src = estimate_rows(node.source, catalog)
+        src = estimate_rows(node.source, catalog, history)
         if not node.group_keys:
             return 1.0
         groups = 1.0
@@ -241,32 +274,43 @@ def estimate_rows(node: PlanNode, catalog: Catalog) -> float:
             return max(1.0, min(groups, src))
         return max(1.0, src * 0.1)
     if isinstance(node, Join):
-        l = estimate_rows(node.left, catalog)
-        r = estimate_rows(node.right, catalog)
+        l = estimate_rows(node.left, catalog, history)
+        r = estimate_rows(node.right, catalog, history)
         if not node.left_keys:
             return l * r if node.join_type == "CROSS" else l
         # |L ⋈ R| ≈ |L||R| / max(ndv(lk), ndv(rk)) (textbook equi-join)
         lnd = _channel_ndv(node.left, node.left_keys[0], catalog)
         rnd = _channel_ndv(node.right, node.right_keys[0], catalog)
         if lnd and rnd:
-            return max(1.0, l * r / max(lnd, rnd))
-        return max(l, r)
+            out = max(1.0, l * r / max(lnd, rnd))
+        else:
+            out = max(l, r)
+        # every equality clause past the first tightens the estimate; an
+        # unknown-NDV clause is floored at the damped per-conjunct default
+        # instead of the old implicit selectivity of 1.0
+        for lk, rk in zip(node.left_keys[1:], node.right_keys[1:]):
+            nd = max(_channel_ndv(node.left, lk, catalog) or 0.0,
+                     _channel_ndv(node.right, rk, catalog) or 0.0)
+            sel = max(1.0 / nd, _EXTRA_JOIN_CLAUSE_SEL) if nd \
+                else _EXTRA_JOIN_CLAUSE_SEL
+            out = max(1.0, out * sel)
+        return out
     if isinstance(node, SemiJoin):
-        return estimate_rows(node.source, catalog)
+        return estimate_rows(node.source, catalog, history)
     if isinstance(node, (Sort,)):
-        return estimate_rows(node.source, catalog)
+        return estimate_rows(node.source, catalog, history)
     if isinstance(node, (TopN, Limit)):
         return float(getattr(node, "count", 1000))
     if isinstance(node, Values):
         return float(len(node.rows))
     if isinstance(node, Union):
-        return sum(estimate_rows(s, catalog) for s in node.sources)
+        return sum(estimate_rows(s, catalog, history) for s in node.sources)
     if isinstance(node, GroupId):
-        return estimate_rows(node.source, catalog) * max(1, len(node.sets))
+        return estimate_rows(node.source, catalog, history) * max(1, len(node.sets))
     if isinstance(node, Unnest):
-        return estimate_rows(node.source, catalog) * 3.0  # avg fan-out guess
+        return estimate_rows(node.source, catalog, history) * 3.0  # avg fan-out guess
     for c in node.children:
-        return estimate_rows(c, catalog)
+        return estimate_rows(c, catalog, history)
     return 1000.0
 
 
@@ -427,7 +471,7 @@ def _restore_layout(child: PlanNode, mapping: list[int], original: PlanNode) -> 
 
 
 def _choose_distribution(build: PlanNode, catalog: Catalog,
-                         join_type: str = "INNER") -> str:
+                         join_type: str = "INNER", history=None) -> str:
     # RIGHT/FULL must partition: a broadcast build would emit its unmatched
     # rows once per task (reference: DetermineJoinDistributionType.java —
     # right/full joins cannot use REPLICATED)
@@ -440,7 +484,21 @@ def _choose_distribution(build: PlanNode, catalog: Catalog,
     # activation barrier from OBSERVED bytes
     limit = int(os.environ.get("TRINO_TPU_BROADCAST_ROW_LIMIT",
                                str(_BROADCAST_LIMIT)) or _BROADCAST_LIMIT)
-    return ("BROADCAST" if estimate_rows(build, catalog) <= limit
+    if history is not None:
+        stats = history.stats_for(build)
+        if stats is not None:
+            # observed build bytes against the same threshold the adaptive
+            # activation barrier uses — the plan-time version of its flip
+            if stats.bytes is not None:
+                from ..execution.adaptive import broadcast_threshold_bytes
+
+                return ("BROADCAST"
+                        if stats.bytes <= broadcast_threshold_bytes(None)
+                        else "PARTITIONED")
+            if stats.rows is not None:
+                return ("BROADCAST" if stats.rows <= limit
+                        else "PARTITIONED")
+    return ("BROADCAST" if estimate_rows(build, catalog, history) <= limit
             else "PARTITIONED")
 
 

@@ -21,7 +21,8 @@ from ..sql.ir import RowExpression
 __all__ = [
     "PlanNode", "TableScan", "Filter", "Project", "AggCall", "Aggregate",
     "GroupId", "Unnest", "TableFunctionScan", "MatchRecognize",
-    "Join", "SemiJoin", "Sort", "SortKey", "TopN", "Limit", "Values",
+    "Join", "SemiJoin", "CorrelatedJoin", "Sort", "SortKey", "TopN",
+    "Limit", "Values",
     "Output", "Exchange", "RemoteSource", "TableWriter", "DistinctLimit",
     "Window", "WindowFunc", "Union", "Replicate", "plan_text",
 ]
@@ -210,6 +211,40 @@ class SemiJoin(PlanNode):
     def label(self) -> str:
         keys = ", ".join(f"#{l}~#{r}" for l, r in zip(self.source_keys, self.filter_keys))
         return f"{'Anti' if self.negated else 'Semi'}Join[{keys}{' residual=' + str(self.residual) if self.residual else ''}]"
+
+
+@dataclass(frozen=True)
+class CorrelatedJoin(PlanNode):
+    """Correlated-subquery placeholder (reference: sql/planner/plan/
+    CorrelatedJoinNode.java).  The logical planner emits it only under the
+    iterative optimizer; the decorrelation rules (planner/iterative/rules/
+    decorrelate.py) lower it before any execution layer sees it.
+
+    ``kind`` selects the decorrelated form:
+
+    - ``scalar_agg`` — correlated scalar aggregate.  ``subquery`` is the
+      pre-chewed keys+value+marker aggregation; output channels are
+      source ++ subquery, and the node lowers to a LEFT equi-join on
+      (source_keys, subquery_keys).
+    - ``in`` — correlated IN-predicate membership.  Output channels are
+      the source's plus one trailing BOOLEAN mark; the node lowers to a
+      null-aware SemiJoin on (source_keys, subquery_keys).
+    """
+
+    source: PlanNode = None
+    subquery: PlanNode = None
+    kind: str = "scalar_agg"  # scalar_agg | in
+    source_keys: tuple[int, ...] = ()
+    subquery_keys: tuple[int, ...] = ()
+
+    @property
+    def children(self):
+        return (self.source, self.subquery)
+
+    def label(self) -> str:
+        keys = ", ".join(f"#{l}~#{r}" for l, r in
+                         zip(self.source_keys, self.subquery_keys))
+        return f"CorrelatedJoin[{self.kind} {keys}]"
 
 
 @dataclass(frozen=True)

@@ -109,10 +109,25 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_HASH_INTERPRET", "bool", "0",
          "Run the Pallas hash kernels in interpret mode (CPU-only "
          "environments and kernel debugging)."),
+    Knob("TRINO_TPU_HBO", "enum", "auto",
+         "History-based optimization: the cost model prefers journaled "
+         "per-fingerprint observed stats (rows, build bytes, partial-agg "
+         "groups) over estimate_rows, and queries record plan_stats at "
+         "completion; 0 disables both sides bit-for-bit.",
+         choices=("auto", "1", "0")),
+    Knob("TRINO_TPU_HBO_ROWS_PER_TASK", "int", "250000",
+         "History-driven task fan-out: observed fragment rows divided by "
+         "this sets the task count (capped at the worker count) for "
+         "fragments whose fingerprint has history."),
     Knob("TRINO_TPU_INTERNAL_SECRET", "str", "",
          "Shared secret authenticating intra-cluster HTTP "
          "(coordinator<->worker); auto-generated per cluster boot when "
          "unset."),
+    Knob("TRINO_TPU_JOIN_REORDER_DP_LIMIT", "int", "6",
+         "Largest inner-join cluster (leaf relation count) the iterative "
+         "optimizer enumerates exhaustively (left-deep dynamic "
+         "programming); bigger clusters use the greedy ordering.  0 "
+         "disables enumeration."),
     Knob("TRINO_TPU_JOURNAL", "bool", "1",
          "Durable query journal (JSONL EventListener); 0 disables."),
     Knob("TRINO_TPU_JOURNAL_DIR", "path", "",
@@ -131,6 +146,11 @@ _DECLARATIONS = (
     Knob("TRINO_TPU_OOM_POLICY", "enum", "largest_query",
          "Victim selection policy for the cluster low-memory killer.",
          choices=("largest_query", "lowest_priority", "youngest")),
+    Knob("TRINO_TPU_OPTIMIZER", "enum", "iterative",
+         "Logical optimizer implementation: iterative is the "
+         "memo/fixpoint rule engine (planner/iterative/); legacy is the "
+         "bit-for-bit single-pass rewrite pipeline.",
+         choices=("iterative", "legacy")),
     Knob("TRINO_TPU_PALLAS", "bool", "1",
          "Master switch for Pallas kernels; 0 forces the jnp fallbacks."),
     Knob("TRINO_TPU_PLAN_CACHE", "bool", "1",

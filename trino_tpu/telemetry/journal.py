@@ -38,14 +38,21 @@ from ..spi.eventlistener import (
 )
 
 __all__ = [
-    "SCHEMA_VERSION", "REQUIRED_FIELDS", "QueryJournal", "default_dir",
-    "journal_enabled", "get_journal", "history", "seeded_peak",
+    "SCHEMA_VERSION", "REQUIRED_FIELDS", "PLAN_STATS_FIELDS", "QueryJournal",
+    "default_dir", "journal_enabled", "get_journal", "history", "seeded_peak",
     "sample_records", "reset_for_test",
 ]
 
-SCHEMA_VERSION = 1
+# v2: adds the per-query ``plan_stats`` event — observed per-plan-node
+# stats (rows/bytes/groups/skew keyed by logical node fingerprint) that
+# planner/history.py feeds back into the cost model on the next planning
+# of the same query shape
+SCHEMA_VERSION = 2
 # every journal record, of any event type, carries at least these
 REQUIRED_FIELDS = ("schema", "event", "ts", "query_id")
+
+# the scalar stats a plan_stats node entry may carry (all optional)
+PLAN_STATS_FIELDS = ("rows", "bytes", "groups", "skew")
 
 _FILE = "query_journal.jsonl"
 
@@ -101,6 +108,20 @@ def _record_from_completed(ev: QueryCompletedEvent) -> dict:
     }
 
 
+def _record_plan_stats(query_id: str, fingerprint: str,
+                       nodes: dict, ts: float) -> dict:
+    """``nodes`` maps logical plan-node fingerprint (planner/history.py)
+    -> {rows, bytes, groups, skew} (each scalar optional)."""
+    return {
+        "schema": SCHEMA_VERSION,
+        "event": "plan_stats",
+        "ts": ts,
+        "query_id": query_id,
+        "fingerprint": fingerprint,
+        "nodes": nodes,
+    }
+
+
 def sample_records() -> list[dict]:
     """One representative record per event type the journal can emit —
     the corpus tools/lint_journal_schema.py validates."""
@@ -124,7 +145,13 @@ def sample_records() -> list[dict]:
         "weight": 1.0,
         "reason": "INTERNAL: injected task failure",
     }
-    return [created, ok, failed, blacklist]
+    plan_stats = _record_plan_stats(
+        "q_sample", "a2f1c3d4",
+        {"e3b0c442": {"rows": 450000, "bytes": 7340032, "skew": 1.25},
+         "9f86d081": {"rows": 45000, "bytes": 524288},
+         "31b2e8c0": {"groups": 1024}},
+        ts=1700000000.0)
+    return [created, ok, failed, blacklist, plan_stats]
 
 
 class QueryJournal(EventListener):
@@ -152,6 +179,12 @@ class QueryJournal(EventListener):
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
         self._write(_record_from_completed(event))
+
+    def plan_stats(self, query_id: str, fingerprint: str,
+                   nodes: dict, ts: float) -> None:
+        """Append one observed-plan-stats record (history-based
+        optimization feed; planner/history.py is both writer and reader)."""
+        self._write(_record_plan_stats(query_id, fingerprint, nodes, ts))
 
     def _write(self, rec: dict) -> None:
         from . import metrics as tm

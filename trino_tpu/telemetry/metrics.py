@@ -606,6 +606,34 @@ ADAPTIVE_SKEW_IMBALANCE = REGISTRY.gauge(
     "divided by after; the load-balance win a parallel host realises")
 
 
+# iterative rule-engine optimizer (planner/iterative/) and history-based
+# optimization (planner/history.py): the runtime-truth -> planning loop
+OPTIMIZER_RUNS = REGISTRY.counter(
+    "trino_optimizer_runs_total",
+    "queries planned by the iterative rule-engine optimizer")
+OPTIMIZER_RULE_FIRINGS = REGISTRY.counter(
+    "trino_optimizer_rule_firings_total",
+    "rule firings across all iterative optimizer runs")
+OPTIMIZER_PLANNING_MS = REGISTRY.counter(
+    "trino_optimizer_planning_ms_total",
+    "wall milliseconds spent inside the iterative optimizer phases")
+HBO_PLAN_LOOKUPS = REGISTRY.counter(
+    "trino_hbo_plan_lookups_total",
+    "plan-node fingerprint lookups against the history table at plan time")
+HBO_PLAN_HITS = REGISTRY.counter(
+    "trino_hbo_plan_hits_total",
+    "plan-time fingerprint lookups answered by journaled observed stats")
+HBO_RECORDS = REGISTRY.counter(
+    "trino_hbo_records_total",
+    "plan_stats journal records written at query completion")
+HBO_RECORD_ERRORS = REGISTRY.counter(
+    "trino_hbo_record_errors_total",
+    "plan_stats recording attempts that failed (swallowed, query unaffected)")
+HBO_FANOUT_ADJUSTED = REGISTRY.counter(
+    "trino_hbo_fanout_adjusted_total",
+    "stages whose task count was shrunk from history-observed input rows")
+
+
 # compressed execution (spi/batch.py encodings + encoding-aware operators):
 # dictionary / RLE / lazy columns flowing through the pipeline instead of
 # flat dense arrays, gated by TRINO_TPU_ENCODED_EXEC

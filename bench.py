@@ -589,6 +589,356 @@ def run_fte_chaos_bench(write: bool = True) -> dict:
     return result
 
 
+def run_ha_bench(write: bool = True) -> dict:
+    """``bench.py --ha``: the HA control-plane certification (PR 20).
+    Writes BENCH_r20.json.  Three legs:
+
+    1. **Lease takeover under load**: a two-coordinator fleet behind the
+       stateless front tier (server/front_tier.py) at steady QPS; one
+       coordinator holds an unrescuable in-flight FTE query and is killed
+       -9.  The peer must claim the lease, adopt the query, and finish it
+       under its ORIGINAL id through the tier's reroute path — zero lost
+       queries, zero re-execution of committed attempts, and post-takeover
+       p99 < 5x steady p99.
+    2. **Elastic autoscaling**: a real process-worker cluster under
+       memory-capped admission; the WorkerAutoscaler must add a worker
+       while ``trino_admission_queued_seconds`` accumulates and drain one
+       (zero-loss PUT /v1/shutdown) once the pressure passes.
+    3. **Legacy parity**: with TRINO_TPU_HA=0 the chaos query mix is
+       bit-for-bit oracle-correct, no HA state appears on disk, and no
+       trino_ha_* activity is recorded.
+    """
+    import shutil
+    import signal
+    import statistics
+    import tempfile
+    import threading
+
+    _ensure_backend()
+    _enable_compile_cache()
+
+    from trino_tpu.execution import ha as ha_mod
+    from trino_tpu.execution import query_state
+    from trino_tpu.telemetry import metrics as tm
+    from trino_tpu.testing import chaos
+    from trino_tpu.testing.chaos import _http_json
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    steady_n = int(os.environ.get("BENCH_HA_QUERIES", "8"))
+    lease_ttl = float(os.environ.get("BENCH_HA_LEASE_TTL_S", "2"))
+
+    # ---------------------------------------- leg 1: takeover under load
+    print("ha leg 1: lease takeover under steady QPS", file=sys.stderr)
+    work = tempfile.mkdtemp(prefix="trino-tpu-ha-bench-")
+    ha_root = os.path.join(work, "ha")
+    base_env = dict(os.environ)
+    base_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "TRINO_TPU_HA": "1",
+        "TRINO_TPU_HA_DIR": ha_root,
+        "TRINO_TPU_HA_LEASE_TTL_S": str(lease_ttl),
+        "TRINO_TPU_HA_HEARTBEAT_S": "0.5",
+        "TRINO_TPU_QUERY_STATE": "1",
+        "TRINO_TPU_SPOOL_DIR": os.path.join(work, "spool"),
+        "TRINO_TPU_JOURNAL_DIR": os.path.join(work, "journal"),
+        "TRINO_TPU_RESULT_CACHE": "0",
+        "PYTHONPATH": repo + os.pathsep + base_env.get("PYTHONPATH", ""),
+    })
+    child_cmd = [sys.executable, "-c",
+                 "from trino_tpu.testing.chaos import _ha_coordinator_child;"
+                 " _ha_coordinator_child()"]
+
+    def _boot(node, extra):
+        port_file = os.path.join(work, f"port-{node}")
+        env = {**base_env, "TRINO_TPU_HA_NODE_ID": node,
+               "TRINO_TPU_QUERY_STATE_DIR":
+                   os.path.join(ha_root, "wal", node),
+               "CHAOS_PORT_FILE": port_file, **extra}
+        proc = subprocess.Popen(child_cmd, env=env, cwd=repo)
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f"HA child {node} died at boot")
+            if os.path.exists(port_file):
+                with open(port_file, encoding="utf-8") as f:
+                    return proc, int(f.read().strip())
+            time.sleep(0.1)
+        proc.kill()
+        raise TimeoutError(f"HA child {node} never wrote its port")
+
+    def _poll_tier(tier_port, first, timeout_s=120.0):
+        out, rows = first, list(first.get("data", []))
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            state = out.get("stats", {}).get("state")
+            nxt = out.get("nextUri")
+            if state == "FAILED" or (state == "FINISHED" and not nxt):
+                return state, rows
+            out = _http_json(
+                "GET", f"http://127.0.0.1:{tier_port}{nxt}", timeout=60.0)
+            rows += out.get("data", [])
+        return "TIMEOUT", rows
+
+    def _run_via_tier(tier_port, sql):
+        t0 = time.monotonic()
+        first = _http_json("POST",
+                           f"http://127.0.0.1:{tier_port}/v1/statement",
+                           sql.encode("utf-8"), timeout=60.0)
+        state, _rows = _poll_tier(tier_port, first)
+        return state, time.monotonic() - t0
+
+    from trino_tpu.server.front_tier import FrontTier
+
+    leg1: dict = {}
+    proc_a = proc_b = None
+    tier = None
+    try:
+        proc_a, port_a = _boot("coordA", {"CHAOS_STALL_S": "300"})
+        proc_b, port_b = _boot("coordB", {})
+        tier = FrontTier(root=ha_root, ttl=lease_ttl, retry_s=30.0).start()
+        tier_port = tier.address[1]
+
+        # the pinned in-flight query: eats coordA's one-shot stall
+        sub = _http_json("POST",
+                         f"http://127.0.0.1:{port_a}/v1/statement",
+                         chaos._DRILL_SQL.encode("utf-8"))
+        drill_qid = sub["id"]
+        wal_a = os.path.join(ha_root, "wal", "coordA", drill_qid + ".wal")
+        pq = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            pq = query_state.load(wal_a)
+            if pq is not None and len(pq.committed) >= 1:
+                break
+            time.sleep(0.1)
+        if pq is None or not pq.committed:
+            raise TimeoutError("no committed attempt before the kill")
+        starts_at_kill = dict(pq.attempt_counts)
+        committed_at_kill = dict(pq.committed)
+
+        steady = [_run_via_tier(tier_port, sql) for sql in
+                  (chaos.QUERY_MIX * 3)[:steady_n]]
+        assert all(s == "FINISHED" for s, _ in steady), steady
+
+        reroutes_before = tm.HA_REROUTES.value()
+        t_kill = time.monotonic()
+        os.kill(proc_a.pid, signal.SIGKILL)
+        proc_a.wait(timeout=30)
+        # takeover: coordB claims the expired lease + WAL custody
+        lease_a = os.path.join(ha_root, "coordinators", "coordA.json")
+        deadline = time.monotonic() + 60.0
+        while os.path.exists(lease_a) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        takeover_s = time.monotonic() - t_kill
+
+        # the in-flight query finishes under its original id, polled
+        # through the tier (reroute: the hash owner is gone)
+        first = _http_json(
+            "GET",
+            f"http://127.0.0.1:{tier_port}/v1/statement/{drill_qid}/0",
+            timeout=60.0)
+        drill_state, drill_rows = _poll_tier(tier_port, first)
+
+        post = [_run_via_tier(tier_port, sql) for sql in
+                (chaos.QUERY_MIX * 3)[:steady_n]]
+
+        wal_root = os.path.join(ha_root, "wal")
+        claimed = [d for d in sorted(os.listdir(wal_root))
+                   if d.startswith("coordA.claimed-coordB-")]
+        final = query_state.load(os.path.join(
+            wal_root, claimed[0], drill_qid + ".wal")) if claimed else None
+        re_executed = {}
+        if final is not None:
+            re_executed = {
+                f"f{fid}_t{t}": final.attempt_counts.get((fid, t), 0)
+                - starts_at_kill.get((fid, t), 0)
+                for (fid, t) in committed_at_kill
+                if final.attempt_counts.get((fid, t), 0)
+                > starts_at_kill.get((fid, t), 0)}
+
+        steady_walls = sorted(w for _s, w in steady)
+        post_walls = sorted(w for _s, w in post)
+
+        def p99(walls):
+            return walls[min(len(walls) - 1,
+                             int(0.99 * len(walls)))] if walls else 0.0
+
+        leg1 = {
+            "steady_queries": len(steady),
+            "post_queries": len(post),
+            "lost_queries": sum(1 for s, _ in steady + post
+                                if s != "FINISHED")
+            + (0 if drill_state == "FINISHED" else 1),
+            "in_flight_state": drill_state,
+            "in_flight_rows": len(drill_rows),
+            "committed_at_kill": len(committed_at_kill),
+            "committed_reexecuted": re_executed,
+            "claimed_dirs": claimed,
+            "takeover_s": round(takeover_s, 2),
+            "tier_reroutes": tm.HA_REROUTES.value() - reroutes_before,
+            "steady_p50_s": round(statistics.median(steady_walls), 3),
+            "steady_p99_s": round(p99(steady_walls), 3),
+            "post_p99_s": round(p99(post_walls), 3),
+            "p99_ratio": round(p99(post_walls)
+                               / max(p99(steady_walls), 1e-9), 2),
+        }
+        # NB: tier_reroutes is informational — in a 2-member fleet the
+        # claimant IS the post-death rehash owner, so the probe path
+        # (covered by tests/test_ha.py) rarely fires here
+        leg1["pass"] = (leg1["lost_queries"] == 0
+                        and drill_state == "FINISHED"
+                        and re_executed == {} and bool(claimed)
+                        and leg1["p99_ratio"] < 5.0)
+    finally:
+        if tier is not None:
+            tier.stop()
+        for p in (proc_a, proc_b):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=15)
+        shutil.rmtree(work, ignore_errors=True)
+
+    # -------------------------------------------- leg 2: elastic workers
+    print("ha leg 2: worker autoscaling", file=sys.stderr)
+    from trino_tpu.execution.remote import ProcessDistributedQueryRunner
+    from trino_tpu.runner import Session
+    from trino_tpu.server.protocol import TrinoTpuServer
+
+    # query_concurrency=1: concurrent clients genuinely queue at the
+    # resource-group gate, which records trino_admission_queued_seconds —
+    # the autoscaler's pressure signal
+    session = Session(node_count=1, retry_policy="QUERY",
+                      query_concurrency=1)
+    runner = ProcessDistributedQueryRunner(
+        chaos.CATALOG_SPEC, worker_count=1, session=session,
+        env_overrides=chaos._ENV)
+    srv = TrinoTpuServer(runner, max_concurrent=4)
+    srv.start()
+    asc = ha_mod.WorkerAutoscaler(runner, min_workers=1, max_workers=2,
+                                  queue_s=0.2, idle_rounds=3,
+                                  interval_s=0.5)
+    leg2: dict = {}
+    try:
+        host, port = srv.address
+        results: list = []
+
+        def client(n):
+            for i in range(n):
+                sql = chaos.QUERY_MIX[i % len(chaos.QUERY_MIX)]
+                first = _http_json(
+                    "POST", f"http://{host}:{port}/v1/statement",
+                    sql.encode("utf-8"), timeout=120.0)
+                out, state = first, None
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    state = out.get("stats", {}).get("state")
+                    nxt = out.get("nextUri")
+                    if state == "FAILED" or (state == "FINISHED"
+                                             and not nxt):
+                        break
+                    out = _http_json(
+                        "GET", f"http://{host}:{port}{nxt}", timeout=60.0)
+                results.append(state)
+
+        asc.start()
+        workers_before = len(runner.workers)
+        clients = [threading.Thread(target=client, args=(4,))
+                   for _ in range(3)]
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join()
+        workers_peak = max([workers_before]
+                           + [e[1] for e in asc.events if e[0] == "up"])
+        # pressure gone: the idle streak must drain back to the floor
+        deadline = time.monotonic() + 30.0
+        while len(runner.workers) > 1 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        workers_after = len(runner.workers)
+        asc.stop()
+        queued_snap = tm.ADMISSION_QUEUED_SECONDS.snapshot()
+        leg2 = {
+            "queries": len(results),
+            "lost_queries": sum(1 for s in results if s != "FINISHED"),
+            "workers_before": workers_before,
+            "workers_peak": workers_peak,
+            "workers_after": workers_after,
+            "events": [list(e) for e in asc.events],
+            "admission_queued_count": queued_snap["count"],
+            "admission_queued_sum_s": round(queued_snap["sum"], 3),
+        }
+        leg2["pass"] = (leg2["lost_queries"] == 0
+                        and workers_peak == 2 and workers_after == 1
+                        and any(e[0] == "up" for e in asc.events)
+                        and any(e[0] == "down" for e in asc.events))
+    finally:
+        asc.stop()
+        srv.stop()
+        runner.close()
+
+    # ----------------------------------------------- leg 3: legacy parity
+    print("ha leg 3: TRINO_TPU_HA=0 parity", file=sys.stderr)
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.testing.oracle import assert_same_rows
+
+    assert os.environ.get("TRINO_TPU_HA", "0") in ("", "0"), \
+        "leg 3 must run with HA off"
+    ha_counters_before = {
+        k: v["value"] for k, v in tm.REGISTRY.snapshot().items()
+        if k.startswith("trino_ha_") and v["kind"] == "counter"
+        and k != "trino_ha_reroutes_total"}  # leg 1's tier ran in-process
+    expected = chaos.build_expected()
+    legacy = DistributedQueryRunner(default_catalog(scale_factor=0.01),
+                                    worker_count=2,
+                                    session=Session(node_count=2))
+    mismatches = 0
+    for sql in chaos.QUERY_MIX:
+        r1 = legacy.execute(sql).rows()
+        r2 = legacy.execute(sql).rows()
+        try:
+            assert_same_rows(r1, expected[sql], ordered=False)
+            assert_same_rows(r2, expected[sql], ordered=False)
+        except AssertionError:
+            mismatches += 1
+    ha_counters_after = {
+        k: v["value"] for k, v in tm.REGISTRY.snapshot().items()
+        if k.startswith("trino_ha_") and v["kind"] == "counter"
+        and k != "trino_ha_reroutes_total"}
+    leg3 = {
+        "queries": 2 * len(chaos.QUERY_MIX),
+        "mismatches": mismatches,
+        "ha_counter_deltas": {
+            k: ha_counters_after[k] - ha_counters_before.get(k, 0)
+            for k in ha_counters_after},
+        "pass": mismatches == 0 and all(
+            ha_counters_after[k] == ha_counters_before.get(k, 0)
+            for k in ha_counters_after),
+    }
+
+    result = {
+        "metric": "ha_takeover_p99_ratio",
+        "value": leg1.get("p99_ratio"),
+        "unit": "post-takeover p99 / steady p99 (target < 5.0; zero lost, "
+                "zero re-executed committed attempts)",
+        "takeover": leg1,
+        "autoscaler": leg2,
+        "legacy_parity": leg3,
+        "pass": bool(leg1.get("pass") and leg2.get("pass")
+                     and leg3.get("pass")),
+        "metrics": {k: v for k, v in tm.REGISTRY.snapshot().items()
+                    if k.startswith("trino_ha_")},
+    }
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("metrics",)}))
+    if write:
+        with open(os.path.join(repo, "BENCH_r20.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
 def run_chaos_bench(write: bool = True) -> dict:
     """``bench.py --chaos``: the chaos-certification soak.  A seeded
     randomized fault-injection campaign (trino_tpu/testing/chaos.py) over
@@ -1967,6 +2317,9 @@ def main() -> None:
         return
     if "--chaos-fte" in sys.argv:
         run_fte_chaos_bench()
+        return
+    if "--ha" in sys.argv:
+        run_ha_bench()
         return
     if "--chaos" in sys.argv:
         run_chaos_bench()

@@ -1,0 +1,431 @@
+"""HA control plane (execution/ha.py + server/front_tier.py): rendezvous
+ownership, lease lifecycle/expiry/deposition, atomic claim races, WAL-dir
+adoption, stateless front-tier routing with failover rerouting, the worker
+autoscaler policy, and the system.runtime.coordinators table."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from trino_tpu.execution import ha, query_state
+
+pytestmark = []
+
+
+@pytest.fixture()
+def ha_env(tmp_path, monkeypatch):
+    root = tmp_path / "ha"
+    monkeypatch.setenv("TRINO_TPU_HA", "1")
+    monkeypatch.setenv("TRINO_TPU_HA_DIR", str(root))
+    monkeypatch.setenv("TRINO_TPU_HA_LEASE_TTL_S", "5")
+    monkeypatch.setenv("TRINO_TPU_HA_HEARTBEAT_S", "60")  # no async renew
+    return str(root)
+
+
+def _write_lease(root, nid, age_s=0.0, url="", epoch=1.0):
+    d = ha.coordinators_dir(root)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, nid + ".json"), "w", encoding="utf-8") as f:
+        json.dump({"node_id": nid, "url": url, "pid": 1, "epoch": epoch,
+                   "ts": time.time() - age_s, "state": "ACTIVE"}, f)
+
+
+# ---------------------------------------------------------- rendezvous
+def test_owner_of_is_deterministic_and_minimally_disruptive():
+    members = ["coord-a", "coord-b", "coord-c"]
+    keys = [f"q{i:04d}" for i in range(200)]
+    owners = {k: ha.owner_of(k, members) for k in keys}
+    assert owners == {k: ha.owner_of(k, list(reversed(members)))
+                      for k in keys}, "order must not matter"
+    assert set(owners.values()) == set(members), "all members get keys"
+    # removing one member remaps ONLY that member's keys
+    survivors = ["coord-a", "coord-c"]
+    for k in keys:
+        if owners[k] != "coord-b":
+            assert ha.owner_of(k, survivors) == owners[k]
+    assert ha.owner_of("q", []) is None
+
+
+# --------------------------------------------------------------- lease
+def test_lease_register_expiry_and_directory(ha_env):
+    lease = ha.CoordinatorLease("coord-x", url="http://h:1", root=ha_env,
+                                ttl=5.0, interval=60.0).register()
+    try:
+        members = ha.read_members(ha_env, ttl=5.0)
+        assert [m.node_id for m in members] == ["coord-x"]
+        assert members[0].state == "ACTIVE"
+        assert members[0].url == "http://h:1"
+        assert members[0].age_s < 2.0
+        # a lease past the TTL reads as EXPIRED and leaves live_members
+        _write_lease(ha_env, "coord-stale", age_s=60.0)
+        by_id = {m.node_id: m for m in ha.read_members(ha_env, ttl=5.0)}
+        assert by_id["coord-stale"].state == "EXPIRED"
+        assert [m.node_id for m in ha.live_members(ha_env, ttl=5.0)] \
+            == ["coord-x"]
+    finally:
+        lease.release()
+    assert not os.path.exists(lease.path), "release removes the lease"
+
+
+def test_lease_deposed_when_claimed_out_from_under(ha_env):
+    lease = ha.CoordinatorLease("coord-z", root=ha_env, ttl=5.0,
+                                interval=60.0).register()
+    try:
+        assert lease.renew()
+        os.remove(lease.path)  # a peer's claim rename, from our view
+        assert not lease.renew()
+        assert lease.deposed
+        # a deposed lease never rewrites its file (zombie defense)
+        assert not os.path.exists(lease.path)
+    finally:
+        lease.release()
+
+
+def test_claim_dead_is_exactly_once_and_moves_wal(ha_env):
+    _write_lease(ha_env, "coord-dead", age_s=60.0, epoch=7.0)
+    wal_dir = ha.node_wal_dir("coord-dead", ha_env)
+    os.makedirs(wal_dir)
+    with open(os.path.join(wal_dir, "q1.wal"), "w", encoding="utf-8") as f:
+        f.write("{}\n")
+
+    wins_a = ha.claim_dead("coord-a", ha_env, ttl=5.0)
+    wins_b = ha.claim_dead("coord-b", ha_env, ttl=5.0)
+    assert [w[0] for w in wins_a] == ["coord-dead"]
+    assert wins_b == [], "second claimant must lose the rename race"
+    claimed_dir = wins_a[0][1]
+    assert claimed_dir and os.path.isdir(claimed_dir)
+    assert not os.path.isdir(wal_dir), "WAL custody moved to the claimant"
+    assert os.path.exists(os.path.join(claimed_dir, "q1.wal"))
+    assert ha.claimed_wal_dirs("coord-a", ha_env) == [claimed_dir]
+    # an ACTIVE peer is never claimed
+    _write_lease(ha_env, "coord-live", age_s=0.0)
+    assert ha.claim_dead("coord-a", ha_env, ttl=5.0) == []
+
+
+def test_concurrent_claim_single_winner(ha_env):
+    _write_lease(ha_env, "coord-dead", age_s=60.0)
+    wins: list = []
+
+    def claim(me):
+        wins.extend(ha.claim_dead(me, ha_env, ttl=5.0))
+
+    threads = [threading.Thread(target=claim, args=(f"coord-{i}",))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1, f"exactly one winner, got {wins}"
+
+
+# ------------------------------------------------------------ adoption
+class _StubDispatcher:
+    def __init__(self):
+        self.adopted: list = []
+
+    def adopt(self, pq) -> bool:
+        self.adopted.append(pq.query_id)
+        return True
+
+    def in_flight(self) -> int:
+        return len(self.adopted)
+
+
+class _StubServer:
+    address = ("127.0.0.1", 0)
+
+    def __init__(self):
+        self.dispatcher = _StubDispatcher()
+
+
+def test_ha_coordinator_step_claims_and_adopts(ha_env):
+    # a dead peer with one resumable query in its WAL dir
+    _write_lease(ha_env, "coord-dead", age_s=60.0)
+    wal = query_state.QueryStateLog(
+        "q_orphan", dir=ha.node_wal_dir("coord-dead", ha_env))
+    wal.begin("select 1", {"plan": 1}, "/s", None)
+    wal.close()
+    # and one already-ended query that must NOT be adopted
+    wal2 = query_state.QueryStateLog(
+        "q_done", dir=ha.node_wal_dir("coord-dead", ha_env))
+    wal2.begin("select 2", {"plan": 2}, "/s", None)
+    wal2.end("FINISHED")
+    wal2.close()
+
+    srv = _StubServer()
+    coord = ha.HACoordinator(srv, nid="coord-b", root=ha_env, ttl=5.0,
+                             interval=60.0)
+    assert coord.step() == ["coord-dead"]
+    assert srv.dispatcher.adopted == ["q_orphan"]
+    assert coord.takeovers == ["coord-dead"]
+    assert coord.step() == [], "a claimed lease cannot be claimed twice"
+
+
+def test_ha_coordinator_reboot_readopts_claimed_custody(ha_env):
+    """A claimant that crashed mid-adoption re-adopts from its claimed
+    dirs at the next boot."""
+    _write_lease(ha_env, "coord-dead", age_s=60.0)
+    wal = query_state.QueryStateLog(
+        "q_orphan2", dir=ha.node_wal_dir("coord-dead", ha_env))
+    wal.begin("select 3", {"plan": 3}, "/s", None)
+    wal.close()
+    assert ha.claim_dead("coord-b", ha_env, ttl=5.0)
+
+    srv = _StubServer()
+    coord = ha.HACoordinator(srv, nid="coord-b", root=ha_env, ttl=5.0,
+                             interval=60.0)
+    coord.start()
+    try:
+        assert srv.dispatcher.adopted == ["q_orphan2"]
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------- front tier
+@pytest.fixture(scope="module")
+def fleet():
+    """Two statement servers over ONE shared in-process runner (cheap:
+    the catalog builds once), each registered in a fleet directory."""
+    import tempfile
+
+    from trino_tpu.connectors.catalog import default_catalog
+    from trino_tpu.execution.distributed_runner import DistributedQueryRunner
+    from trino_tpu.runner import Session
+    from trino_tpu.server.protocol import TrinoTpuServer
+
+    root = tempfile.mkdtemp(prefix="trino-tpu-ha-fleet-")
+    runner = DistributedQueryRunner(
+        default_catalog(scale_factor=0.01), worker_count=2,
+        session=Session(node_count=2))
+    servers = {}
+    leases = {}
+    for nid in ("coord-a", "coord-b"):
+        srv = TrinoTpuServer(runner).start()
+        host, port = srv.address
+        leases[nid] = ha.CoordinatorLease(
+            nid, url=f"http://{host}:{port}", root=root, ttl=30.0,
+            interval=60.0).register()
+        servers[nid] = srv
+    yield root, servers
+    for lease in leases.values():
+        lease.release()
+    for srv in servers.values():
+        srv.stop()
+
+
+def _drain(tier, first: dict, timeout_s: float = 60.0) -> tuple:
+    """Follow nextUri through the tier until terminal; -> (state, rows)."""
+    from urllib.request import urlopen
+
+    host, port = tier.address
+    out, rows = first, list(first.get("data", []))
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        state = out.get("stats", {}).get("state")
+        nxt = out.get("nextUri")
+        if state == "FAILED" or (state == "FINISHED" and not nxt):
+            return state, rows
+        with urlopen(f"http://{host}:{port}{nxt}", timeout=30) as resp:
+            out = json.loads(resp.read())
+        rows += out.get("data", [])
+    return "TIMEOUT", rows
+
+
+def test_front_tier_routes_post_to_hash_owner(fleet):
+    from urllib.request import Request, urlopen
+
+    from trino_tpu.server.front_tier import FrontTier
+
+    root, servers = fleet
+    tier = FrontTier(root=root, ttl=30.0, retry_s=2.0).start()
+    try:
+        host, port = tier.address
+        req = Request(f"http://{host}:{port}/v1/statement",
+                      data=b"select count(*) from nation", method="POST")
+        with urlopen(req, timeout=60) as resp:
+            first = json.loads(resp.read())
+        qid = first["id"]
+        state, rows = _drain(tier, first)
+        assert state == "FINISHED"
+        assert rows == [[25]]
+        # the query landed on (exactly) the rendezvous owner
+        owner = ha.owner_of(qid, ["coord-a", "coord-b"])
+        assert servers[owner].dispatcher.get(qid) is not None
+        other = "coord-b" if owner == "coord-a" else "coord-a"
+        assert servers[other].dispatcher.get(qid) is None
+    finally:
+        tier.stop()
+
+
+def test_front_tier_reroutes_when_owner_disowns_query(fleet):
+    """A query living on the NON-owner (post-takeover shape: the claimant
+    adopted it, the hash still points at the dead node's successor) is
+    found by the probe-all-members pass and served."""
+    from urllib.request import urlopen
+
+    from trino_tpu.server.front_tier import FrontTier
+    from trino_tpu.telemetry import metrics as tm
+
+    root, servers = fleet
+    tier = FrontTier(root=root, ttl=30.0, retry_s=2.0).start()
+    try:
+        host, port = tier.address
+        # place a finished query directly on a chosen server, under a qid
+        # whose hash owner is the OTHER server
+        for probe in range(1000):
+            qid = f"reroute{probe:04d}"
+            if ha.owner_of(qid, ["coord-a", "coord-b"]) == "coord-a":
+                continue
+            break
+        q = servers["coord-a"].dispatcher.submit(
+            "select count(*) from region", qid=qid)
+        q.done.wait(timeout=60)
+        before = tm.HA_REROUTES.value()
+        with urlopen(f"http://{host}:{port}/v1/statement/{qid}/0",
+                     timeout=60) as resp:
+            out = json.loads(resp.read())
+        state, rows = _drain(tier, out)
+        assert state == "FINISHED"
+        assert rows == [[5]]
+        assert tm.HA_REROUTES.value() == before + 1
+        # the pin is warm now: the next poll must not re-count a reroute
+        with urlopen(f"http://{host}:{port}/v1/statement/{qid}/0",
+                     timeout=60) as resp:
+            json.loads(resp.read())
+        assert tm.HA_REROUTES.value() == before + 1
+    finally:
+        tier.stop()
+
+
+def test_front_tier_synthetic_queued_inside_retry_window(fleet):
+    """While NO member knows the query (mid-takeover), polls inside the
+    retry budget get a synthetic QUEUED page with an unchanged nextUri;
+    past the budget the truth (404) surfaces."""
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    from trino_tpu.server.front_tier import FrontTier
+
+    root, _servers = fleet
+    tier = FrontTier(root=root, ttl=30.0, retry_s=0.4).start()
+    try:
+        host, port = tier.address
+        path = "/v1/statement/nosuchquery00001/0"
+        with urlopen(f"http://{host}:{port}{path}", timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert out["stats"]["state"] == "QUEUED"
+        assert out["nextUri"] == path
+        time.sleep(0.6)
+        with pytest.raises(HTTPError) as exc:
+            urlopen(f"http://{host}:{port}{path}", timeout=60)
+        assert exc.value.code == 404
+    finally:
+        tier.stop()
+
+
+# ---------------------------------------------------------- autoscaler
+class _ScalableRunner:
+    def __init__(self, n: int):
+        self.n = n
+
+    @property
+    def active_worker_count(self) -> int:
+        return self.n
+
+    def add_worker(self):
+        self.n += 1
+
+    def remove_worker(self):
+        self.n -= 1
+        return f"w{self.n}"
+
+
+def test_autoscaler_grows_under_queue_pressure_and_respects_ceiling():
+    r = _ScalableRunner(1)
+    asc = ha.WorkerAutoscaler(r, min_workers=1, max_workers=3,
+                              queue_s=0.5, idle_rounds=2, interval_s=999)
+    assert asc.step(queued_delta_s=1.0) == "up" and r.n == 2
+    assert asc.step(queued_delta_s=1.0) == "up" and r.n == 3
+    assert asc.step(queued_delta_s=1.0) is None, "ceiling reached"
+    assert r.n == 3
+
+
+def test_autoscaler_drains_after_idle_rounds_and_respects_floor():
+    r = _ScalableRunner(3)
+    asc = ha.WorkerAutoscaler(r, min_workers=1, max_workers=3,
+                              queue_s=0.5, idle_rounds=2, interval_s=999)
+    assert asc.step(queued_delta_s=0.0) is None, "one idle round is not enough"
+    assert asc.step(queued_delta_s=0.0) == "down" and r.n == 2
+    assert asc.step(queued_delta_s=0.0) is None
+    assert asc.step(queued_delta_s=0.0) == "down" and r.n == 1
+    for _ in range(4):
+        assert asc.step(queued_delta_s=0.0) is None, "floor reached"
+    assert r.n == 1
+    # pressure resets the idle streak
+    r2 = _ScalableRunner(2)
+    asc2 = ha.WorkerAutoscaler(r2, min_workers=1, max_workers=3,
+                               queue_s=0.5, idle_rounds=2, interval_s=999)
+    assert asc2.step(queued_delta_s=0.0) is None
+    assert asc2.step(queued_delta_s=9.9) == "up"
+    assert asc2.step(queued_delta_s=0.0) is None, "streak was reset"
+
+
+def test_autoscaler_reads_admission_queue_metric():
+    from trino_tpu.telemetry import metrics as tm
+
+    r = _ScalableRunner(1)
+    asc = ha.WorkerAutoscaler(r, min_workers=1, max_workers=2,
+                              queue_s=0.5, idle_rounds=99, interval_s=999)
+    assert asc.step() is None, "no queueing recorded yet"
+    tm.ADMISSION_QUEUED_SECONDS.record(0.7)
+    assert asc.step() == "up", "queued-seconds delta must trigger growth"
+    assert asc.step() is None, "the delta was consumed"
+
+
+def test_autoscaler_logical_drain_on_inprocess_runner(fleet):
+    """Against the real in-process runner the scale-down path is a logical
+    drain (NodeManager), and scale-up restores the drained slot."""
+    _root, servers = fleet
+    runner = servers["coord-a"].dispatcher.runner
+    n0 = runner.active_worker_count
+    asc = ha.WorkerAutoscaler(runner, min_workers=1, max_workers=n0,
+                              queue_s=0.5, idle_rounds=1, interval_s=999)
+    try:
+        assert asc.step(queued_delta_s=0.0) == "down"
+        assert runner.active_worker_count == n0 - 1
+        assert asc.step(queued_delta_s=1.0) == "up"
+        assert runner.active_worker_count == n0
+    finally:
+        for nid in list(asc._drained):
+            runner.restore_worker(nid)
+
+
+# ------------------------------------------- system.runtime.coordinators
+def test_coordinators_table_without_ha(fleet):
+    _root, servers = fleet
+    runner = servers["coord-a"].dispatcher.runner
+    rows = runner.execute(
+        "select coordinator, state, url from system.runtime.coordinators"
+    ).rows()
+    assert len(rows) == 1
+    assert rows[0][1] == "ACTIVE"
+
+
+def test_coordinators_table_reads_fleet(fleet, monkeypatch):
+    root, servers = fleet
+    monkeypatch.setenv("TRINO_TPU_HA", "1")
+    monkeypatch.setenv("TRINO_TPU_HA_DIR", root)
+    monkeypatch.setenv("TRINO_TPU_HA_LEASE_TTL_S", "30")
+    runner = servers["coord-a"].dispatcher.runner
+    rows = runner.execute(
+        "select coordinator, state, lease_age_ms, in_flight_queries, url "
+        "from system.runtime.coordinators order by coordinator").rows()
+    by_id = {r[0]: r for r in rows}
+    assert set(by_id) == {"coord-a", "coord-b"}
+    for r in rows:
+        assert r[1] == "ACTIVE"
+        assert r[2] >= 0.0
+        assert r[4].startswith("http://")

@@ -209,3 +209,54 @@ def test_journal_schema_lint_catches_bad_record():
     assert any("nested" not in p and "stats" in p for p in problems)
     assert lint_record({"schema": journal.SCHEMA_VERSION, "event": "x",
                         "ts": float("nan"), "query_id": "q"})
+
+
+# ------------------------------------------ satellite: fleet journal fold
+def test_fleet_members_write_own_streams_and_readers_fold(tmp_path,
+                                                          monkeypatch):
+    """Each fleet member appends to its own ``query_journal-<node>.jsonl``
+    stream (no cross-process rotation races); every reader folds ALL
+    streams — including rotated generations — oldest-first per stream."""
+    d = str(tmp_path / "fleet")
+    monkeypatch.setenv("TRINO_TPU_HA_NODE_ID", "coordA")
+    ja = journal.QueryJournal(directory=d)
+    assert ja.path.endswith("query_journal-coordA.jsonl")
+    ja.query_completed(_completed("q_a1", peak=1 << 20))
+    monkeypatch.setenv("TRINO_TPU_HA_NODE_ID", "coordB")
+    jb = journal.QueryJournal(directory=d, max_bytes=256, max_files=2)
+    jb.query_completed(_completed("q_b1", peak=2 << 20))
+    jb.query_completed(_completed("q_b2", peak=3 << 20))  # forces rotation
+    monkeypatch.delenv("TRINO_TPU_HA_NODE_ID")
+    jc = journal.QueryJournal(directory=d)  # legacy single-node name
+    jc.query_completed(_completed("q_c1", peak=4 << 20))
+
+    ids = {r["query_id"] for r in jc.read()}
+    assert ids == {"q_a1", "q_b1", "q_b2", "q_c1"}, \
+        "read() must fold every member's stream"
+    assert ids == {r["query_id"] for r in ja.read()}, \
+        "the fold is symmetric: A sees B and the legacy stream too"
+    assert len(jc.fleet_files()) >= 4  # A + B current + B rotated + legacy
+
+
+def test_peer_journal_append_invalidates_admission_seed(tmp_path,
+                                                        monkeypatch):
+    """The admission estimator's seed-cache signature covers the FLEET
+    file set: a peak recorded by a PEER coordinator reaches this
+    process's estimate without any restart."""
+    monkeypatch.setenv("TRINO_TPU_JOURNAL_DIR", str(tmp_path / "fj"))
+    journal.reset_for_test()
+    me = journal.get_journal()
+    assert me is not None
+    fp = rt.fingerprint("select * from fleet_big")
+    default = 64 << 20
+    assert estimate_peak_memory(fp, default) == default
+
+    # a peer (distinct node id -> distinct stream) lands a history record
+    monkeypatch.setenv("TRINO_TPU_HA_NODE_ID", "coordPeer")
+    peer = journal.QueryJournal(directory=me.directory)
+    peer.query_completed(_completed("q_peer", sql="select * from fleet_big",
+                                    peak=7 << 20))
+    monkeypatch.delenv("TRINO_TPU_HA_NODE_ID")
+
+    assert estimate_peak_memory(fp, default) == 7 << 20, \
+        "the peer's append must invalidate the local seed cache"

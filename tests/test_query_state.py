@@ -261,3 +261,27 @@ def test_coordinator_kill9_restart_resume(tmp_path):
         return sorted(out, key=str)
 
     assert norm(got) == norm(expected)
+
+
+# ------------------------------------------- subprocess HA lease takeover
+def test_ha_peer_takeover_kill9(tmp_path):
+    """The HA tentpole acceptance: coordinator A (one of a two-member
+    fleet) commits >=1 fsync'd attempt and dies by SIGKILL; peer B claims
+    A's expired lease (atomic rename), takes custody of A's WAL directory,
+    and finishes the query under its ORIGINAL id — zero re-execution of
+    committed attempts, polled the whole time through B's ordinary
+    statement surface."""
+    from trino_tpu.testing.chaos import run_ha_takeover_drill
+
+    rec = run_ha_takeover_drill(workdir=str(tmp_path))
+    assert rec["state"] == "FINISHED", rec.get("error")
+    assert rec["committed_at_kill"] >= 1
+    assert rec["committed_reexecuted"] == {}, \
+        "committed attempts were re-executed after the takeover"
+    assert rec["claimed_dirs"], "B never took custody of A's WAL dir"
+    assert rec["wal_ended"] == "FINISHED"
+    assert rec["lease_a_gone"], "A's lease must leave the directory"
+    assert rec["pass"]
+    # the adopted query's rows are the drill aggregation (4 flag/status
+    # groups at sf=0.01)
+    assert len(rec["rows"]) == 4

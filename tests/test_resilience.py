@@ -14,6 +14,7 @@ engine-level FailureInjector on the CPU mesh:
 - worker replacement honors ``Session.max_worker_replacements``.
 """
 
+import os
 import time
 
 import pytest
@@ -432,3 +433,70 @@ def test_worker_replacement_cap_honored():
         assert [w.alive() for w in r.workers].count(True) == 1
     finally:
         r.close()
+
+
+# ----------------------------- satellite: fleet-shared durable blacklist
+def test_shared_blacklist_two_writers_merge_and_ttl(tmp_path):
+    """Two coordinators pointing TRINO_TPU_BLACKLIST_PATH at one file:
+    strikes recorded under A are visible (and additive) under B — no
+    last-writer-wins clobbering — and TTL decay applies fleet-wide."""
+    from trino_tpu.execution.speculation import ClusterBlacklist
+
+    shared = str(tmp_path / "blacklist.jsonl")
+    a = ClusterBlacklist(ttl_s=3600.0, threshold=2.0, persist=True,
+                         path=shared)
+    b = ClusterBlacklist(ttl_s=3600.0, threshold=2.0, persist=True,
+                         path=shared)
+
+    a.record_failure("worker-1", reason="REMOTE_HOST_GONE", query_id="qa")
+    assert a.score("worker-1") == 1.0
+    assert b.score("worker-1") == 1.0, "A's strike must merge into B"
+    assert not b.is_blacklisted("worker-1")
+    # the second strike comes from the OTHER coordinator: the scores fold
+    b.record_failure("worker-1", reason="REMOTE_TASK_ERROR", query_id="qb")
+    assert b.is_blacklisted("worker-1")
+    assert a.is_blacklisted("worker-1"), \
+        "the blacklisting must be cluster-wide, not per-coordinator"
+    # no double counting of a writer's own appends
+    assert a.score("worker-1") == 2.0
+    assert b.score("worker-1") == 2.0
+
+    # a third coordinator booting later merges the whole history on load
+    c = ClusterBlacklist(ttl_s=3600.0, threshold=2.0, persist=True,
+                         path=shared)
+    assert c.is_blacklisted("worker-1")
+
+    # TTL decay: to a tiny-TTL member every recorded strike is expired
+    tiny = ClusterBlacklist(ttl_s=1e-9, threshold=2.0, persist=True,
+                            path=shared)
+    import time as _t
+    _t.sleep(0.01)
+    assert tiny.score("worker-1") == 0.0
+
+
+def test_shared_blacklist_survives_interleaved_subprocess_writers(tmp_path):
+    """Cross-process: two real subprocesses interleave O_APPEND strikes
+    into the same file; a fresh reader folds every record."""
+    import subprocess
+    import sys
+
+    shared = str(tmp_path / "bl.jsonl")
+    child = (
+        "import sys\n"
+        "from trino_tpu.execution.resilience import SharedBlacklistStore\n"
+        "s = SharedBlacklistStore(sys.argv[1])\n"
+        "for i in range(50):\n"
+        "    s.append('worker-x', 1.0, 'REMOTE_TASK_ERROR', sys.argv[2])\n"
+    )
+    procs = [subprocess.run([sys.executable, "-c", child, shared, tag],
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))),
+                            capture_output=True, text=True, timeout=300)
+             for tag in ("qa", "qb")]
+    for p in procs:
+        assert p.returncode == 0, p.stderr[-2000:]
+
+    from trino_tpu.execution.resilience import SharedBlacklistStore
+    recs = SharedBlacklistStore(shared).poll()
+    assert len(recs) == 100, "no torn or clobbered records"
+    assert {r["query_id"] for r in recs} == {"qa", "qb"}

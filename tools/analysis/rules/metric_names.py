@@ -43,7 +43,8 @@ KINDS = ("counter", "gauge", "distribution")
 # that telemetry
 REQUIRED_FAMILIES = ("trino_profile_", "trino_journal_", "trino_cache_",
                      "trino_adaptive_", "trino_fte_", "trino_encoding_",
-                     "trino_resident_", "trino_optimizer_", "trino_hbo_")
+                     "trino_resident_", "trino_optimizer_", "trino_hbo_",
+                     "trino_ha_")
 
 
 def _registrations(tree: ast.Module, lines: list) -> list:

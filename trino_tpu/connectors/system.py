@@ -79,6 +79,13 @@ _TABLES = {
         ("blacklist_score", DOUBLE), ("running_tasks", BIGINT),
         ("queued_tasks", BIGINT), ("last_heartbeat_age_ms", DOUBLE),
     ]),
+    # HA coordinator fleet (execution/ha.py lease directory); with HA off
+    # this is the single local coordinator
+    "runtime.coordinators": _schema("runtime.coordinators", [
+        ("coordinator", VARCHAR), ("state", VARCHAR),
+        ("lease_age_ms", DOUBLE), ("in_flight_queries", BIGINT),
+        ("url", VARCHAR),
+    ]),
     "metrics.counters": _schema("metrics.counters", [
         ("name", VARCHAR), ("kind", VARCHAR), ("value", DOUBLE),
     ]),
@@ -209,6 +216,8 @@ class SystemConnector(Connector):
             ]
         if table == "runtime.workers":
             return self._worker_rows()
+        if table == "runtime.coordinators":
+            return self._coordinator_rows()
         if table == "runtime.caches":
             from .. import caching
 
@@ -234,6 +243,30 @@ class SystemConnector(Connector):
                     out.append((name, kind, float(snap["value"])))
             return out
         raise KeyError(f"no such system table: {table!r}")
+
+    def _coordinator_rows(self) -> list[tuple]:
+        """The coordinator fleet from the HA lease directory.  With HA off
+        (or no fleet registered yet) the single local coordinator is
+        synthesized so the table is never empty mid-query."""
+        from ..execution import ha
+
+        rows = []
+        if ha.ha_enabled() and ha.ha_dir():
+            for m in ha.read_members():
+                rows.append((m.node_id, m.state, m.age_s * 1000.0,
+                             m.in_flight, m.url))
+        if not rows:
+            runner = self._runner() if self._runner is not None else None
+            dispatcher = getattr(runner, "dispatcher", None)
+            running = 0
+            if dispatcher is not None:
+                try:
+                    running = sum(1 for i in dispatcher.queries()
+                                  if i.state in ("QUEUED", "RUNNING"))
+                except Exception:
+                    running = 0
+            rows.append((ha.node_id(), "ACTIVE", 0.0, running, ""))
+        return rows
 
     def _worker_rows(self) -> list[tuple]:
         """Per-worker operational view: failure-detector state, cluster

@@ -132,7 +132,14 @@ def run_fte_query(runner, subplan: SubPlan,
 
     wal: Optional[query_state.QueryStateLog] = None
     if qid and query_state.enabled():
-        wal = query_state.QueryStateLog(qid)
+        # a resumed query keeps appending to the WAL it was recovered
+        # from — under HA lease takeover that directory belongs to the
+        # DEAD coordinator's claimed custody, not this process's own
+        # state dir, and writing anywhere else would strand the log
+        wal_dir = (os.path.dirname(resume.path)
+                   if resume is not None and getattr(resume, "path", None)
+                   else None)
+        wal = query_state.QueryStateLog(qid, dir=wal_dir)
         if resume is None:
             wal.begin(sql, subplan, spool_root, session,
                       task_counts=task_counts,

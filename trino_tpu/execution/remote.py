@@ -483,6 +483,35 @@ class ProcessDistributedQueryRunner(DistributedQueryRunner):
         self.resilience_events.append(("drain", w.url, "drained"))
         return summary
 
+    # --------------------------------------------------------- elasticity
+    def add_worker(self) -> WorkerProcess:
+        """Grow the fleet by one worker process (autoscaler scale-up).
+        Placement picks it up on the next heartbeat sweep; running FTE
+        stages keep their recorded task fan-out (shape_matches), new
+        queries fan out wider."""
+        w = WorkerProcess(self._env_overrides)
+        self._monitor_worker(w)
+        self.workers.append(w)
+        self.failure_detector.sweep_once()
+        self.resilience_events.append(("scale", w.url, "added"))
+        return w
+
+    def remove_worker(self, timeout_s: Optional[float] = None
+                      ) -> Optional[str]:
+        """Shrink the fleet by one worker (autoscaler scale-down): drain
+        the last slot through the zero-loss shutdown protocol WITHOUT a
+        replacement, then drop it from the fleet.  Returns the removed
+        worker's url, or None when only one worker remains."""
+        live = [w for w in self.workers if w.alive()]
+        if len(live) <= 1:
+            return None
+        w = live[-1]
+        self.drain_worker(w, timeout_s=timeout_s, replace=False)
+        self.failure_detector.unmonitor(w.url)
+        self.workers.remove(w)
+        self.resilience_events.append(("scale", w.url, "removed"))
+        return w.url
+
     def rolling_restart(self, timeout_s: Optional[float] = None
                         ) -> list[dict]:
         """Drain + replace every worker slot, one at a time — the rolling

@@ -409,7 +409,8 @@ class ClusterBlacklist:
     def __init__(self, ttl_s: Optional[float] = None,
                  threshold: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 persist: bool = False):
+                 persist: bool = False,
+                 path: Optional[str] = None):
         if ttl_s is None:
             ttl_s = float(os.environ.get("TRINO_TPU_BLACKLIST_TTL_S", "300"))
         if threshold is None:
@@ -424,8 +425,47 @@ class ClusterBlacklist:
         self._lock = threading.Lock()
         # worker -> list of (monotonic ts, weight, reason)
         self._entries: dict[str, list[tuple[float, float, str]]] = {}
+        # fleet-shared durable store (execution/resilience.py): when the
+        # whole coordinator fleet points TRINO_TPU_BLACKLIST_PATH at one
+        # file, strikes are appended there and merged on every read — a
+        # worker that fails under coordinator A is blacklisted under B too,
+        # and concurrent writers interleave instead of clobbering
+        self._store = None
         if persist:
-            self.seed_from_journal()
+            from .resilience import SharedBlacklistStore, blacklist_path
+
+            shared = path if path is not None else blacklist_path()
+            if shared:
+                self._store = SharedBlacklistStore(shared)
+                self._merge_store()
+            else:
+                self.seed_from_journal()
+
+    def _merge_store(self) -> None:
+        """Fold every strike appended to the shared store since the last
+        merge (ours and our peers') into the in-memory table, back-dated on
+        this process's monotonic clock so TTL decay expires each entry at
+        the same wall moment fleet-wide."""
+        if self._store is None:
+            return
+        recs = self._store.poll()
+        if not recs:
+            return
+        now_wall = time.time()
+        now = self._clock()
+        with self._lock:
+            for rec in recs:
+                try:
+                    age = now_wall - float(rec["ts"])
+                    worker = rec["worker"]
+                    weight = float(rec.get("weight", 1.0))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if not 0 <= age < self.ttl_s:
+                    continue
+                self._entries.setdefault(worker, []).append(
+                    (now - age, weight, str(rec.get("reason", ""))))
+            self._prune_locked(now)
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.ttl_s
@@ -438,6 +478,16 @@ class ClusterBlacklist:
 
     def record_failure(self, worker: str, reason: str = "",
                        weight: float = 1.0, query_id: str = "") -> float:
+        if self._store is not None:
+            # the shared file is the single source of truth: append the
+            # strike there and read it back through the ordinary merge (no
+            # separate local insert — that would double-count our own rows)
+            self._store.append(worker, weight, reason, query_id)
+            self._merge_store()
+            with self._lock:
+                score = sum(e[1] for e in self._entries.get(worker, ()))
+            self._refresh_gauge()
+            return score
         now = self._clock()
         with self._lock:
             self._prune_locked(now)
@@ -502,6 +552,7 @@ class ClusterBlacklist:
         return kept
 
     def score(self, worker: str) -> float:
+        self._merge_store()
         now = self._clock()
         with self._lock:
             self._prune_locked(now)
@@ -511,6 +562,7 @@ class ClusterBlacklist:
         return self.score(worker) >= self.threshold
 
     def blacklisted(self) -> frozenset:
+        self._merge_store()
         now = self._clock()
         with self._lock:
             self._prune_locked(now)
@@ -522,6 +574,7 @@ class ClusterBlacklist:
 
     def snapshot(self) -> dict[str, float]:
         """worker -> current score (system.runtime.workers feed)."""
+        self._merge_store()
         now = self._clock()
         with self._lock:
             self._prune_locked(now)

@@ -1,0 +1,247 @@
+"""Stateless HTTP front tier for the HA coordinator fleet.
+
+The miniature of the reference's dispatcher tier split (a load balancer
+in front of N dispatchers in front of N coordinators): clients speak the
+ordinary statement protocol (server/protocol.py) to ONE stable address
+and never learn the fleet topology.
+
+- ``POST /v1/statement``: the tier mints the query id itself and forwards
+  to the owning coordinator — rendezvous hash over the live membership
+  (execution/ha.py ``owner_of``) — passing the id down via the
+  ``X-Trino-Tpu-Query-Id`` header so routing and identity agree.
+- ``GET /v1/statement/{id}/{token}`` / ``DELETE``: routed by the same
+  hash.  When the owner is unreachable or does not know the query (it
+  died; a peer claimed its lease and adopted the query), the tier probes
+  every live member and pins the one that answers.  While nobody answers
+  — the takeover window — polls get a synthetic ``QUEUED`` page with an
+  unchanged ``nextUri`` for up to ``TRINO_TPU_HA_ROUTE_RETRY_S``, so a
+  client polling through a failover sees a slow query, never an error.
+
+The tier holds no query state: every response is recomputed from the
+lease directory plus one proxied upstream call, so any number of tier
+replicas can run behind one load balancer and a tier restart loses
+nothing (the routing pin cache is a pure latency optimisation).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..execution import ha
+
+__all__ = ["FrontTier"]
+
+
+class _Upstream:
+    """One proxied call's outcome."""
+
+    __slots__ = ("status", "body")
+
+    def __init__(self, status: int, body: bytes):
+        self.status = status
+        self.body = body
+
+
+def _call(url: str, method: str, body: Optional[bytes] = None,
+          headers: Optional[dict] = None,
+          timeout: float = 30.0) -> Optional[_Upstream]:
+    """HTTP round trip; None on transport failure (dead coordinator)."""
+    req = urllib.request.Request(url, data=body, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _Upstream(resp.status, resp.read())
+    except urllib.error.HTTPError as e:
+        return _Upstream(e.code, e.read())
+    except (urllib.error.URLError, OSError):
+        return None
+
+
+class FrontTier:
+    """Stateless statement-protocol router over the coordinator fleet."""
+
+    def __init__(self, root: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 ttl: Optional[float] = None,
+                 retry_s: Optional[float] = None,
+                 call_timeout: float = 30.0):
+        from ..spi.knobs import get_float
+
+        self.root = root or ha.ha_dir()
+        self.ttl = ha.lease_ttl_s() if ttl is None else ttl
+        self.retry_s = (get_float("TRINO_TPU_HA_ROUTE_RETRY_S") or 15.0
+                        ) if retry_s is None else retry_s
+        self.call_timeout = call_timeout
+        # qid -> coordinator url that last answered for it (latency pin,
+        # safe to lose); qid -> first-miss wall ts (failover grace window)
+        self._pins: dict[str, str] = {}
+        self._misses: dict[str, float] = {}
+        self._lock = threading.Lock()
+        handler = type("_BoundFrontHandler", (_FrontHandler,),
+                       {"tier": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- topology
+    def members(self) -> list:
+        return ha.live_members(self.root, self.ttl)
+
+    def owner_url(self, qid: str) -> Optional[str]:
+        members = self.members()
+        owner = ha.owner_of(qid, [m.node_id for m in members])
+        for m in members:
+            if m.node_id == owner:
+                return m.url
+        return None
+
+    # -------------------------------------------------------------- routing
+    def route_post(self, sql: bytes) -> tuple[int, dict]:
+        qid = uuid.uuid4().hex[:16]
+        deadline = time.monotonic() + self.retry_s
+        while True:
+            url = self.owner_url(qid)
+            if url is not None:
+                up = _call(f"{url}/v1/statement", "POST", body=sql,
+                           headers={"X-Trino-Tpu-Query-Id": qid},
+                           timeout=self.call_timeout)
+                if up is not None:
+                    with self._lock:
+                        self._pins[qid] = url
+                    return up.status, _decode(up.body)
+            # owner down and not yet claimed: wait out a slice of the
+            # failover window and rehash over the new membership
+            if time.monotonic() >= deadline:
+                return 503, {"error": {
+                    "message": "no live coordinator for query"}}
+            time.sleep(0.1)
+
+    def route_query(self, qid: str, path: str,
+                    method: str = "GET") -> tuple[int, dict]:
+        """Route one ``/v1/statement/{qid}/...`` poll (or DELETE)."""
+        from ..telemetry import metrics as tm
+
+        tried = []
+        with self._lock:
+            pin = self._pins.get(qid)
+        candidates = [pin] if pin else []
+        owner = self.owner_url(qid)
+        if owner and owner not in candidates:
+            candidates.append(owner)
+        for url in candidates:
+            up = _call(f"{url}{path}", method, timeout=self.call_timeout)
+            tried.append(url)
+            if up is not None and up.status == 200:
+                self._answered(qid, url)
+                return up.status, _decode(up.body)
+        # the routed coordinator is dead or disowned the query: a peer may
+        # have adopted it — probe the whole live fleet
+        for m in self.members():
+            if m.url in tried:
+                continue
+            up = _call(f"{m.url}{path}", method, timeout=self.call_timeout)
+            if up is not None and up.status == 200:
+                tm.HA_REROUTES.inc()
+                self._answered(qid, m.url)
+                return up.status, _decode(up.body)
+        if method == "GET":
+            # nobody answers: inside the takeover window clients see a
+            # synthetic QUEUED page and keep polling the same nextUri
+            now = time.monotonic()
+            with self._lock:
+                first = self._misses.setdefault(qid, now)
+            if now - first <= self.retry_s:
+                return 200, {"id": qid, "stats": {"state": "QUEUED"},
+                             "nextUri": path}
+        return 404, {"error": {"message": f"unknown query {qid}"}}
+
+    def _answered(self, qid: str, url: str) -> None:
+        with self._lock:
+            self._pins[qid] = url
+            self._misses.pop(qid, None)
+            if len(self._pins) > 4096:  # stateless: pins are disposable
+                self._pins.clear()
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def start(self) -> "FrontTier":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="trino-tpu-front-tier",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def _decode(body: bytes) -> dict:
+    try:
+        out = json.loads(body)
+        return out if isinstance(out, dict) else {"value": out}
+    except ValueError:
+        return {"error": {"message": "bad upstream payload"}}
+
+
+class _FrontHandler(BaseHTTPRequestHandler):
+    tier: FrontTier = None  # set by FrontTier
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path.rstrip("/") != "/v1/statement":
+            self._send(404, {"error": {"message": "not found"}})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        sql = self.rfile.read(length)
+        code, payload = self.tier.route_post(sql)
+        self._send(code, payload)
+
+    def do_GET(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if parts == ["v1", "metrics"]:
+            from ..telemetry.metrics import REGISTRY
+
+            body = REGISTRY.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if len(parts) == 4 and parts[:2] == ["v1", "statement"]:
+            code, payload = self.tier.route_query(parts[2], self.path)
+            self._send(code, payload)
+            return
+        self._send(404, {"error": {"message": "not found"}})
+
+    def do_DELETE(self):
+        parts = [p for p in self.path.strip("/").split("/") if p]
+        if len(parts) >= 3 and parts[:2] == ["v1", "statement"]:
+            code, payload = self.tier.route_query(parts[2], self.path,
+                                                  method="DELETE")
+            self._send(code, payload)
+            return
+        self._send(404, {"error": {"message": "not found"}})

@@ -83,14 +83,8 @@ class QueryDispatcher:
             pending = []
         keep = []
         for pq in pending:
-            q = _Query(pq.query_id, pq.sql)
-            q.recovered = True
-            with self._lock:
-                self.queries[q.id] = q
-            if pq.spool_root:
+            if self.adopt(pq) and pq.spool_root:
                 keep.append(pq.spool_root)
-            self.recovered_query_ids.append(pq.query_id)
-            self.pool.submit(self._resume, q, pq)
         try:
             query_state.prune_ended()
             # roots under recovery are pinned; everything else follows
@@ -99,13 +93,38 @@ class QueryDispatcher:
         except Exception:
             pass
 
+    def adopt(self, pq) -> bool:
+        """Register one WAL-recovered query under its ORIGINAL id and
+        resume it.  Shared by boot-time self-recovery and HA lease
+        takeover (execution/ha.py), where the WAL dir being adopted
+        belonged to a dead fleet peer.  False if the id is already live
+        here (double-adoption guard)."""
+        with self._lock:
+            if pq.query_id in self.queries:
+                return False
+            q = _Query(pq.query_id, pq.sql)
+            q.recovered = True
+            self.queries[q.id] = q
+        self.recovered_query_ids.append(pq.query_id)
+        self.pool.submit(self._resume, q, pq)
+        return True
+
+    def in_flight(self) -> int:
+        """Queries registered and not yet done (lease-file enrichment and
+        the runtime.coordinators table)."""
+        with self._lock:
+            return sum(1 for q in self.queries.values()
+                       if not q.done.is_set())
+
     MAX_RETAINED = 256
 
-    def submit(self, sql: str) -> _Query:
+    def submit(self, sql: str, qid: Optional[str] = None) -> _Query:
+        """``qid`` lets the HA front tier pre-assign the query id it hashed
+        the owning coordinator from, so routing and identity agree."""
         from ..telemetry.metrics import DISPATCHER_QUERIES
 
         DISPATCHER_QUERIES.inc()
-        q = _Query(uuid.uuid4().hex[:16], sql)
+        q = _Query(qid or uuid.uuid4().hex[:16], sql)
         with self._lock:
             self.queries[q.id] = q
             # bound the registry: evict oldest finished queries (the
@@ -265,7 +284,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", "0"))
         sql = self.rfile.read(length).decode("utf-8")
-        q = self.dispatcher.submit(sql)
+        qid = (self.headers.get("X-Trino-Tpu-Query-Id") or "").strip() or None
+        q = self.dispatcher.submit(sql, qid=qid)
         self._send(200, self._query_payload(q, 0))
 
     def _cluster_metrics(self) -> str:

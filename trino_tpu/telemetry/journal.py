@@ -57,6 +57,10 @@ PLAN_STATS_FIELDS = ("rows", "bytes", "groups", "skew")
 _FILE = "query_journal.jsonl"
 
 
+def _safe_node(node: str) -> str:
+    return "".join(c if c.isalnum() or c in "_.-" else "_" for c in node)
+
+
 def default_dir() -> str:
     try:
         uid = os.getuid()
@@ -166,7 +170,13 @@ class QueryJournal(EventListener):
             os.environ.get("TRINO_TPU_JOURNAL_MAX_BYTES", str(4 << 20)))
         self.max_files = max_files if max_files is not None else int(
             os.environ.get("TRINO_TPU_JOURNAL_FILES", "3"))
-        self.path = os.path.join(self.directory, _FILE)
+        # a coordinator fleet shares one TRINO_TPU_JOURNAL_DIR: each member
+        # appends to its OWN stream (cross-process appends to one file would
+        # race its rotation) and readers fold every member's stream
+        node = os.environ.get("TRINO_TPU_HA_NODE_ID", "").strip()
+        name = _FILE if not node else \
+            _FILE[:-len(".jsonl")] + "-" + _safe_node(node) + ".jsonl"
+        self.path = os.path.join(self.directory, name)
         self._lock = threading.Lock()
         # first write of this process checks for a torn tail line (a crash
         # mid-write); appending straight onto it would corrupt the next
@@ -228,17 +238,52 @@ class QueryJournal(EventListener):
 
     # --------------------------------------------------------- reader side
     def files(self) -> list[str]:
-        """Journal files oldest-first (rotated generations then current)."""
+        """This member's journal files oldest-first (rotated generations
+        then current)."""
         out = [f"{self.path}.{i}" for i in range(self.max_files, 0, -1)]
         out.append(self.path)
         return [p for p in out if os.path.exists(p)]
+
+    def fleet_files(self) -> list[str]:
+        """Every fleet member's journal files under the shared directory,
+        oldest-first per stream, streams in name order — the READ set.  In
+        a single-coordinator deployment this is exactly :meth:`files`; in a
+        fleet it additionally folds the sibling ``query_journal-*`` streams
+        other coordinators rotate, so journal-seeded admission estimates
+        and ``system.runtime.query_history`` see the whole fleet's memory,
+        not just the local rotation set."""
+        stem = _FILE[:-len(".jsonl")]
+        streams: dict[str, list[tuple[int, str]]] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return self.files()
+        for name in names:
+            if not name.startswith(stem):
+                continue
+            base, gen = name, 0
+            if ".jsonl." in name:
+                base, _, suffix = name.rpartition(".")
+                if not suffix.isdigit():
+                    continue
+                gen = int(suffix)
+            if not base.endswith(".jsonl"):
+                continue
+            streams.setdefault(base, []).append(
+                (gen, os.path.join(self.directory, name)))
+        out = []
+        for base in sorted(streams):
+            # oldest generation first (highest .N), current (gen 0) last
+            for _gen, path in sorted(streams[base], reverse=True):
+                out.append(path)
+        return out or self.files()
 
     def read(self, events: Optional[tuple] = None) -> list[dict]:
         """Every parseable record, oldest-first; a torn tail line (crash
         mid-write) is skipped, not fatal — the journal must be readable
         after any kill."""
         out: list[dict] = []
-        for path in self.files():
+        for path in self.fleet_files():
             try:
                 with open(path, encoding="utf-8") as f:
                     for line in f:
@@ -271,8 +316,10 @@ _SEED_LOCK = threading.Lock()
 
 
 def _journal_signature(j: QueryJournal) -> tuple:
+    # the FLEET file set: a peer coordinator's append or rotation must
+    # invalidate the admission seed cache exactly like a local one
     sig = []
-    for path in j.files():
+    for path in j.fleet_files():
         try:
             st = os.stat(path)
         except OSError:

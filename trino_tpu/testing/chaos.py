@@ -51,7 +51,7 @@ from .oracle import SqliteOracle, assert_same_rows
 
 __all__ = ["QUERY_MIX", "USER_ERROR_SQL", "build_expected",
            "run_scenario", "run_chaos", "run_fte_scenario", "run_fte_chaos",
-           "run_coordinator_kill_drill"]
+           "run_coordinator_kill_drill", "run_ha_takeover_drill"]
 
 CATALOG_SPEC = {
     "factory": "trino_tpu.connectors.catalog:default_catalog",
@@ -570,6 +570,213 @@ def run_coordinator_kill_drill(stall_s: float = 300.0,
         return record
     finally:
         for p in (proc1, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=15)
+        if workdir is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+# ------------------------------------------------- HA fleet lease takeover
+
+def _ha_coordinator_child() -> None:
+    """Subprocess entry for the HA takeover drill: one fleet member.  Boots
+    the 2-worker FTE coordinator behind the statement protocol, wraps it in
+    an :class:`~trino_tpu.execution.ha.HACoordinator` (lease + failover
+    watcher), writes its bound port to ``CHAOS_PORT_FILE``, and serves
+    until killed.  ``CHAOS_STALL_S`` arms the same one-shot unrescuable
+    stall as the single-coordinator drill — only the victim node gets it."""
+    import os
+
+    from ..connectors.catalog import default_catalog as _catalog
+    from ..execution.distributed_runner import DistributedQueryRunner as _R
+    from ..execution.failure_injector import FailureInjector as _Inj
+    from ..execution.failure_injector import TASK_STALL as _STALL
+    from ..execution.ha import HACoordinator
+    from ..runner import Session as _S
+    from ..server.protocol import TrinoTpuServer
+
+    inj = None
+    stall_s = float(os.environ.get("CHAOS_STALL_S", "0") or 0)
+    if stall_s > 0:
+        inj = _Inj()
+        inj.inject(_STALL, fragment_id=None, task_index=0, attempt=0,
+                   times=1, stall_s=stall_s)
+    session = _S(node_count=2, retry_policy="TASK", fte_speculative=False,
+                 failure_injector=inj)
+    runner = _R(_catalog(scale_factor=0.01), worker_count=2,
+                session=session)
+    srv = TrinoTpuServer(runner).start()
+    HACoordinator(srv).start()
+    port_file = os.environ["CHAOS_PORT_FILE"]
+    with open(port_file + ".tmp", "w", encoding="utf-8") as f:
+        f.write(str(srv.address[1]))
+    os.replace(port_file + ".tmp", port_file)
+    while True:
+        time.sleep(1.0)
+
+
+def run_ha_takeover_drill(stall_s: float = 300.0,
+                          lease_ttl_s: float = 2.0,
+                          heartbeat_s: float = 0.5,
+                          boot_timeout_s: float = 180.0,
+                          finish_timeout_s: float = 180.0,
+                          workdir: Optional[str] = None) -> dict:
+    """The HA tentpole drill: kill -9 one coordinator of a two-member
+    fleet mid-FTE-query and certify a PEER (not a restart) finishes it.
+
+    Coordinator A boots with an unrescuable one-shot stall and owns the
+    drill query; B is healthy.  After >=1 fsync'd committed attempt lands
+    in A's WAL, A is SIGKILLed.  B's failover watcher must claim A's
+    expired lease (atomic lease-file rename), take custody of A's WAL
+    directory, adopt the query under its ORIGINAL id, resume from the
+    committed-attempt map and finish — the parent polls B's ordinary
+    ``GET /v1/statement/{qid}/{token}`` surface throughout.  Asserts from
+    the claimed WAL's attempt counters that committed attempts were never
+    re-executed, and that A's lease is gone from the cluster directory."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from ..execution import query_state
+
+    work = workdir or tempfile.mkdtemp(prefix="trino-tpu-ha-drill-")
+    ha_root = os.path.join(work, "ha")
+    spool_dir = os.path.join(work, "spool")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    base_env = dict(os.environ)
+    base_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "TRINO_TPU_HA": "1",
+        "TRINO_TPU_HA_DIR": ha_root,
+        "TRINO_TPU_HA_LEASE_TTL_S": str(lease_ttl_s),
+        "TRINO_TPU_HA_HEARTBEAT_S": str(heartbeat_s),
+        "TRINO_TPU_QUERY_STATE": "1",
+        "TRINO_TPU_SPOOL_DIR": spool_dir,
+        "TRINO_TPU_JOURNAL_DIR": os.path.join(work, "journal"),
+        "TRINO_TPU_RESULT_CACHE": "0",
+        "PYTHONPATH": repo_root + os.pathsep + base_env.get("PYTHONPATH",
+                                                            ""),
+    })
+    child_cmd = [sys.executable, "-c",
+                 "from trino_tpu.testing.chaos import _ha_coordinator_child;"
+                 " _ha_coordinator_child()"]
+
+    def _boot(node: str, extra_env: dict) -> tuple:
+        port_file = os.path.join(work, f"port-{node}")
+        env = {**base_env,
+               "TRINO_TPU_HA_NODE_ID": node,
+               "TRINO_TPU_QUERY_STATE_DIR": os.path.join(
+                   ha_root, "wal", node),
+               "CHAOS_PORT_FILE": port_file,
+               **extra_env}
+        proc = subprocess.Popen(child_cmd, env=env, cwd=repo_root)
+        deadline = time.monotonic() + boot_timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"HA child {node} died at boot (rc={proc.returncode})")
+            if os.path.exists(port_file):
+                with open(port_file, encoding="utf-8") as f:
+                    return proc, int(f.read().strip())
+            time.sleep(0.1)
+        proc.kill()
+        raise TimeoutError(f"HA child {node} never wrote its port")
+
+    record: dict = {"sql": _DRILL_SQL, "workdir": work}
+    proc_a = proc_b = None
+    try:
+        proc_a, port_a = _boot("coordA", {"CHAOS_STALL_S": str(stall_s)})
+        proc_b, port_b = _boot("coordB", {})
+
+        # the query must land on A (the stalled victim): submit straight to
+        # A's statement endpoint — ownership in the drill is by submission,
+        # the front-tier hash path is exercised by bench.py --ha
+        sub = _http_json("POST", f"http://127.0.0.1:{port_a}/v1/statement",
+                         _DRILL_SQL.encode("utf-8"))
+        qid = sub["id"]
+        record["query_id"] = qid
+        wal_a = os.path.join(ha_root, "wal", "coordA", qid + ".wal")
+        pq = None
+        deadline = time.monotonic() + boot_timeout_s
+        while time.monotonic() < deadline:
+            pq = query_state.load(wal_a)
+            if pq is not None and len(pq.committed) >= 1:
+                break
+            time.sleep(0.1)
+        if pq is None or not pq.committed:
+            raise TimeoutError("no committed attempt before the kill")
+        committed_at_kill = dict(pq.committed)
+        starts_at_kill = dict(pq.attempt_counts)
+        record["committed_at_kill"] = len(committed_at_kill)
+        t_kill = time.monotonic()
+        os.kill(proc_a.pid, signal.SIGKILL)
+        proc_a.wait(timeout=30)
+
+        # B's watcher claims the expired lease and finishes the query under
+        # its original id; the client just switches which address it polls
+        rows: list = []
+        state = None
+        token = 0
+        deadline = time.monotonic() + finish_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                out = _http_json(
+                    "GET",
+                    f"http://127.0.0.1:{port_b}/v1/statement/{qid}/{token}")
+            except Exception:  # 404 until B adopts; keep polling
+                time.sleep(0.2)
+                continue
+            state = out.get("stats", {}).get("state")
+            if state == "FAILED":
+                record["error"] = out.get("error")
+                break
+            rows += out.get("data", [])
+            nxt = out.get("nextUri")
+            if state == "FINISHED":
+                if not nxt:
+                    break
+                token += 1
+                continue
+            time.sleep(0.2)
+        record["state"] = state
+        record["rows"] = rows
+        record["takeover_s"] = round(time.monotonic() - t_kill, 2)
+
+        # A's WAL now lives under B's claimed custody
+        wal_root = os.path.join(ha_root, "wal")
+        claimed = [d for d in sorted(os.listdir(wal_root))
+                   if d.startswith("coordA.claimed-coordB-")]
+        record["claimed_dirs"] = claimed
+        final = None
+        if claimed:
+            final = query_state.load(
+                os.path.join(wal_root, claimed[0], qid + ".wal"))
+        re_executed = {}
+        if final is not None:
+            re_executed = {
+                f"f{fid}_t{t}": final.attempt_counts.get((fid, t), 0)
+                - starts_at_kill.get((fid, t), 0)
+                for (fid, t) in committed_at_kill
+                if final.attempt_counts.get((fid, t), 0)
+                > starts_at_kill.get((fid, t), 0)
+            }
+        record["committed_reexecuted"] = re_executed
+        record["wal_ended"] = final.ended if final is not None else None
+        record["lease_a_gone"] = not os.path.exists(
+            os.path.join(ha_root, "coordinators", "coordA.json"))
+        record["pass"] = (state == "FINISHED" and bool(claimed)
+                          and final is not None and not re_executed
+                          and final.ended == "FINISHED"
+                          and record["lease_a_gone"])
+        return record
+    finally:
+        for p in (proc_a, proc_b):
             if p is not None and p.poll() is None:
                 p.kill()
                 p.wait(timeout=15)
